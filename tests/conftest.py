@@ -1,0 +1,21 @@
+"""Test configuration: force a virtual 8-device CPU mesh before jax imports.
+
+This is the MiniCluster analog (SURVEY.md §4): the reference tests "distributed"
+execution on an in-JVM Flink MiniCluster with multiple task slots; here we test
+multi-shard SPMD on one host by splitting the CPU backend into 8 XLA devices.
+Must run before jax initializes, hence module-level in conftest.
+"""
+
+import os
+import sys
+
+# Force CPU even when the session env preselects a TPU platform (JAX_PLATFORMS
+# may arrive as "axon" — the tunneled TPU); tests always run on the virtual mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

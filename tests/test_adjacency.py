@@ -1,0 +1,56 @@
+"""Adjacency summary tests mirroring util/AdjacencyListGraphTest.java."""
+
+from gelly_streaming_tpu.summaries.adjacency import AdjacencyListGraph
+
+
+def test_add_edge():
+    # Mirrors AdjacencyListGraphTest.testAddEdge (:32-54)
+    g = AdjacencyListGraph(capacity=32, max_degree=8)
+    g.add_edge(1, 2)
+    m = g.adjacency_map()
+    assert len(m) == 2
+    assert 2 in m[1] and 1 in m[2]
+    assert len(m[1]) == 1 and len(m[2]) == 1
+
+    g.add_edge(1, 3)
+    m = g.adjacency_map()
+    assert len(m) == 3
+    assert 2 in m[1] and 3 in m[1] and 1 in m[3]
+
+    g.add_edge(3, 1)  # duplicate in reverse: idempotent
+    m = g.adjacency_map()
+    assert len(m) == 3
+    assert len(m[1]) == 2 and len(m[3]) == 1
+
+    g.add_edge(1, 2)  # exact duplicate: idempotent
+    m = g.adjacency_map()
+    assert len(m) == 3
+    assert len(m[1]) == 2 and len(m[2]) == 1
+
+
+def test_bounded_bfs():
+    # Mirrors AdjacencyListGraphTest.testBoundedBFS (:58-85): the spanner
+    # admission sequence — boundedBFS(src, trg, k) == True means "within k hops"
+    # (edge dropped); False means the edge must be added.
+    g = AdjacencyListGraph(capacity=32, max_degree=8)
+    g.add_edge(1, 4)
+    g.add_edge(4, 5)
+    g.add_edge(5, 6)
+    g.add_edge(4, 7)
+    g.add_edge(7, 8)
+
+    assert g.bounded_bfs(2, 3, 3) is False
+    g.add_edge(2, 3)
+
+    assert g.bounded_bfs(3, 4, 3) is False
+    g.add_edge(3, 4)
+
+    assert g.bounded_bfs(3, 6, 3) is True  # 3-4-5-6: 3 hops -> dropped
+
+    assert g.bounded_bfs(8, 9, 3) is False
+    g.add_edge(8, 9)
+
+    assert g.bounded_bfs(8, 6, 3) is False
+    g.add_edge(8, 6)
+
+    assert g.bounded_bfs(5, 9, 3) is True  # 5-6-8-9: 3 hops -> dropped

@@ -1,0 +1,69 @@
+"""Unit tests for the key-grouping primitives (ops/segments.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.ops import segments
+
+
+def _ranks_ref(keys, mask):
+    seen = {}
+    out = []
+    for k, m in zip(keys, mask):
+        if not m:
+            out.append(0)
+            continue
+        out.append(seen.get(k, 0))
+        seen[k] = seen.get(k, 0) + 1
+    return out
+
+
+def test_occurrence_rank_simple():
+    keys = jnp.array([5, 3, 5, 5, 3, 9], jnp.int32)
+    ranks = segments.occurrence_rank(keys)
+    np.testing.assert_array_equal(np.asarray(ranks), [0, 0, 1, 2, 1, 0])
+
+
+def test_occurrence_rank_masked():
+    keys = jnp.array([5, 5, 5, 5], jnp.int32)
+    mask = jnp.array([True, False, True, True])
+    ranks = segments.occurrence_rank(keys, mask)
+    valid = np.asarray(ranks)[np.asarray(mask)]
+    np.testing.assert_array_equal(valid, [0, 1, 2])
+
+
+def test_occurrence_rank_random_vs_reference():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 17, size=256).astype(np.int32)
+    mask = rng.random(256) < 0.8
+    got = np.asarray(segments.occurrence_rank(jnp.asarray(keys), jnp.asarray(mask)))
+    want = _ranks_ref(keys, mask)
+    np.testing.assert_array_equal(got[mask], np.array(want)[mask])
+
+
+def test_first_occurrence_mask():
+    keys = jnp.array([1, 2, 1, 3, 2, 1], jnp.int32)
+    mask = jnp.array([True, True, True, False, True, True])
+    first = np.asarray(segments.first_occurrence_mask(keys, mask))
+    np.testing.assert_array_equal(first, [True, True, False, False, False, False])
+
+
+def test_group_counts_and_segment_sum():
+    keys = jnp.array([0, 1, 1, 2, 2, 2], jnp.int32)
+    mask = jnp.array([True, True, True, True, True, False])
+    counts = np.asarray(segments.group_counts(keys, 4, mask))
+    np.testing.assert_array_equal(counts, [1, 2, 2, 0])
+    vals = jnp.array([10, 1, 2, 3, 4, 100], jnp.int32)
+    sums = np.asarray(segments.segment_sum(vals, keys, 4, mask))
+    np.testing.assert_array_equal(sums, [10, 3, 7, 0])
+
+
+def test_sort_by_key_groups_valid_first():
+    keys = jnp.array([7, 2, 7, 2], jnp.int32)
+    mask = jnp.array([True, True, False, True])
+    order, sk = segments.sort_by_key(keys, mask)
+    order = np.asarray(order)
+    # valid rows first: key-2 rows (1, 3) then key-7 row (0); padding row 2 last
+    np.testing.assert_array_equal(order, [1, 3, 0, 2])
+    b = np.asarray(segments.segment_boundaries(sk))
+    np.testing.assert_array_equal(b, [True, False, True, True])

@@ -1,0 +1,166 @@
+"""Union-find kernel tests: fixed-point equivalence with a sequential reference.
+
+Mirrors the reference's DisjointSetTest (util/DisjointSetTest.java) and adds
+randomized equivalence checks of the batched kernel against a plain sequential
+union-find.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.ops import unionfind as uf
+from gelly_streaming_tpu.summaries.disjoint_set import DisjointSet
+
+
+class _SeqUF:
+    """Plain sequential union-find used as ground truth."""
+
+    def __init__(self, n):
+        self.p = list(range(n))
+
+    def find(self, v):
+        while self.p[v] != v:
+            self.p[v] = self.p[self.p[v]]
+            v = self.p[v]
+        return v
+
+    def union(self, a, b):
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.p[max(ra, rb)] = min(ra, rb)
+
+
+def _labels(parent):
+    p = np.asarray(uf.compress(jnp.asarray(parent)))
+    return p
+
+
+def test_union_edges_simple_chain():
+    parent = uf.init_parent(8)
+    src = jnp.array([0, 1, 2], jnp.int32)
+    dst = jnp.array([1, 2, 3], jnp.int32)
+    p = _labels(uf.union_edges(parent, src, dst))
+    assert p[0] == p[1] == p[2] == p[3] == 0
+    assert p[4] == 4 and p[7] == 7
+
+
+def test_union_edges_masked_rows_do_nothing():
+    parent = uf.init_parent(8)
+    src = jnp.array([0, 5], jnp.int32)
+    dst = jnp.array([1, 6], jnp.int32)
+    mask = jnp.array([True, False])
+    p = _labels(uf.union_edges(parent, src, dst, mask))
+    assert p[0] == p[1] == 0
+    assert p[5] == 5 and p[6] == 6
+
+
+def test_union_edges_random_matches_sequential():
+    rng = np.random.default_rng(42)
+    n = 128
+    for trial in range(5):
+        m = int(rng.integers(1, 200))
+        src = rng.integers(0, n, size=m).astype(np.int32)
+        dst = rng.integers(0, n, size=m).astype(np.int32)
+        seq = _SeqUF(n)
+        for a, b in zip(src, dst):
+            seq.union(int(a), int(b))
+        want = np.array([seq.find(v) for v in range(n)])
+        got = _labels(uf.union_edges(uf.init_parent(n), jnp.asarray(src), jnp.asarray(dst)))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_incremental_batches_match_one_shot():
+    rng = np.random.default_rng(7)
+    n = 64
+    src = rng.integers(0, n, size=60).astype(np.int32)
+    dst = rng.integers(0, n, size=60).astype(np.int32)
+    p_inc = uf.init_parent(n)
+    for i in range(0, 60, 10):
+        p_inc = uf.union_edges(p_inc, jnp.asarray(src[i : i + 10]), jnp.asarray(dst[i : i + 10]))
+    p_one = uf.union_edges(uf.init_parent(n), jnp.asarray(src), jnp.asarray(dst))
+    np.testing.assert_array_equal(_labels(p_inc), _labels(p_one))
+
+
+def test_merge_parents():
+    n = 32
+    pa = uf.union_edges(uf.init_parent(n), jnp.array([1], jnp.int32), jnp.array([2], jnp.int32))
+    pb = uf.union_edges(uf.init_parent(n), jnp.array([2], jnp.int32), jnp.array([3], jnp.int32))
+    merged = _labels(uf.merge_parents(pa, pb))
+    assert merged[1] == merged[2] == merged[3] == 1
+
+
+# ---- DisjointSet API parity (mirrors util/DisjointSetTest.java) -------------
+
+
+def _setup_ds():
+    ds = DisjointSet(capacity=128)
+    for i in range(8):
+        ds.union(i, i + 2)  # DisjointSetTest.java:36-41
+    return ds
+
+
+def test_get_matches_size():
+    ds = _setup_ds()
+    assert len(ds.get_matches()) == 10  # DisjointSetTest.java:43-46
+
+
+def test_find_parity():
+    ds = _setup_ds()
+    root1 = ds.find(0)
+    root2 = ds.find(1)
+    assert root1 != root2
+    for i in range(10):
+        assert ds.find(i) == (root1 if i % 2 == 0 else root2)
+
+
+def test_merge_parity():
+    ds = _setup_ds()
+    ds2 = DisjointSet(capacity=128)
+    for i in range(8):
+        ds2.union(i, i + 100)
+    ds2.merge(ds)
+    assert len(ds2.get_matches()) == 18
+    roots = {ds2.find(v) for v in ds2.get_matches()}
+    assert len(roots) == 2  # DisjointSetTest.java:58-77
+
+
+def test_tostring_format():
+    ds = DisjointSet(capacity=16)
+    for a, b in [(1, 2), (1, 3), (2, 3), (1, 5), (6, 7), (8, 9)]:
+        ds.union(a, b)
+    assert str(ds) == "{1=[1, 2, 3, 5], 6=[6, 7], 8=[8, 9]}"
+
+
+# ---- parity (signed) union-find ---------------------------------------------
+
+
+def test_parity_bipartite_path():
+    c = 16
+    p2 = uf.init_parity_parent(c)
+    src = jnp.array([1, 2, 3], jnp.int32)
+    dst = jnp.array([2, 3, 4], jnp.int32)
+    p2 = uf.parity_union_edges(p2, src, dst)
+    seen = jnp.zeros((c,), bool).at[jnp.array([1, 2, 3, 4])].set(True)
+    assert bool(uf.is_bipartite(p2, seen))
+
+
+def test_parity_odd_cycle_fails():
+    c = 16
+    p2 = uf.init_parity_parent(c)
+    src = jnp.array([1, 2, 3], jnp.int32)
+    dst = jnp.array([2, 3, 1], jnp.int32)
+    p2 = uf.parity_union_edges(p2, src, dst)
+    seen = jnp.zeros((c,), bool).at[jnp.array([1, 2, 3])].set(True)
+    assert not bool(uf.is_bipartite(p2, seen))
+    conflicts = np.asarray(uf.parity_conflicts(p2, seen))
+    assert conflicts[[1, 2, 3]].all()
+
+
+def test_parity_even_cycle_ok():
+    c = 16
+    p2 = uf.init_parity_parent(c)
+    src = jnp.array([1, 2, 3, 4], jnp.int32)
+    dst = jnp.array([2, 3, 4, 1], jnp.int32)
+    p2 = uf.parity_union_edges(p2, src, dst)
+    seen = jnp.zeros((c,), bool).at[jnp.array([1, 2, 3, 4])].set(True)
+    assert bool(uf.is_bipartite(p2, seen))
