@@ -30,6 +30,8 @@ _EXPORTS = {
     "EventType": ("gelly_streaming_tpu.core.types", "EventType"),
     "EdgeDirection": ("gelly_streaming_tpu.core.types", "EdgeDirection"),
     "StreamConfig": ("gelly_streaming_tpu.core.config", "StreamConfig"),
+    "EdgeStream": ("gelly_streaming_tpu.core.stream", "EdgeStream"),
+    "SnapshotStream": ("gelly_streaming_tpu.core.snapshot", "SnapshotStream"),
 }
 
 __all__ = list(_EXPORTS)

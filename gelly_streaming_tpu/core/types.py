@@ -78,7 +78,7 @@ class EdgeBatch:
         else:
             mask = jnp.asarray(mask, dtype=bool)
         if val is not None:
-            val = jnp.asarray(val)
+            val = jax.tree.map(jnp.asarray, val)
         if time is not None:
             # Relative stream time in ms (int32): windows are assigned on the
             # host, so device timestamps only need to order events within a run.
@@ -107,7 +107,14 @@ class EdgeBatch:
         val = None
         time = None
         if len(edges[0]) > 2:
-            val = np.array([e[2] for e in edges])
+            first = edges[0][2]
+            if isinstance(first, tuple):
+                # tuple-valued edges become a tuple-of-columns pytree
+                val = tuple(
+                    np.array([e[2][k] for e in edges]) for k in range(len(first))
+                )
+            else:
+                val = np.array([e[2] for e in edges])
         if with_time and len(edges[0]) > 3:
             time = np.array([e[3] for e in edges], dtype=np.int32)
         return EdgeBatch.from_arrays(src, dst, val=val, time=time, pad_to=pad_to)
@@ -130,16 +137,19 @@ class EdgeBatch:
             return self
         pad = capacity - n
 
-        def _pad(x, fill=0):
-            if x is None:
-                return None
+        def _pad1(x, fill=0):
             return jnp.concatenate(
                 [x, jnp.full((pad,) + x.shape[1:], fill, dtype=x.dtype)]
             )
 
+        def _pad(x, fill=0):
+            if x is None:
+                return None
+            return jax.tree.map(lambda leaf: _pad1(leaf, fill), x)
+
         return EdgeBatch(
-            src=_pad(self.src),
-            dst=_pad(self.dst),
+            src=_pad1(self.src),
+            dst=_pad1(self.dst),
             mask=jnp.concatenate([self.mask, jnp.zeros((pad,), bool)]),
             val=_pad(self.val),
             time=_pad(self.time),
@@ -156,35 +166,57 @@ class EdgeBatch:
         return dataclasses.replace(self, **kw)
 
     def concat(self, other: "EdgeBatch") -> "EdgeBatch":
-        def _cat(a, b):
+        def _cat(a, b, field, fill=None):
             if a is None and b is None:
                 return None
-            # One-sided optional field (e.g. an empty batch from a quiet source
-            # interval): synthesize neutral values for the missing side — those
-            # rows are masked anyway.
-            if a is None:
-                a = jnp.zeros(self.src.shape, dtype=b.dtype)
-            if b is None:
-                b = jnp.zeros(other.src.shape, dtype=a.dtype)
-            return jnp.concatenate([a, b])
+            # One-sided optional field: synthesize the field's *semantic
+            # default* for the side missing it (sign=None means "all
+            # additions" -> fill +1; val -> zeros).  Event time cannot be
+            # invented, so a one-sided time is an error.
+            if (a is None) != (b is None):
+                if fill is None:
+                    raise ValueError(
+                        f"cannot concat batches where only one side has {field!r}"
+                    )
+                length = (self.src if a is None else other.src).shape[0]
+
+                def synth(leaf):
+                    return jnp.full((length,) + leaf.shape[1:], fill, leaf.dtype)
+
+                if a is None:
+                    a = jax.tree.map(synth, b)
+                else:
+                    b = jax.tree.map(synth, a)
+            return jax.tree.map(lambda x, y: jnp.concatenate([x, y]), a, b)
 
         return EdgeBatch(
-            src=_cat(self.src, other.src),
-            dst=_cat(self.dst, other.dst),
-            mask=_cat(self.mask, other.mask),
-            val=_cat(self.val, other.val),
-            time=_cat(self.time, other.time),
-            sign=_cat(self.sign, other.sign),
+            src=jnp.concatenate([self.src, other.src]),
+            dst=jnp.concatenate([self.dst, other.dst]),
+            mask=jnp.concatenate([self.mask, other.mask]),
+            val=_cat(self.val, other.val, "val", fill=0),
+            time=_cat(self.time, other.time, "time"),
+            sign=_cat(self.sign, other.sign, "sign", fill=1),
         )
 
     # ---- host-side inspection ----------------------------------------------
 
     def to_tuples(self) -> list:
-        """Materialize valid edges as host tuples (testing/sinks only)."""
+        """Materialize valid edges as host tuples (testing/sinks only).
+
+        A pytree-valued ``val`` (e.g. a tuple of arrays from mapEdges-to-tuple)
+        renders as a nested tuple per row, matching Flink's Tuple CSV rendering.
+        """
         src = np.asarray(self.src)
         dst = np.asarray(self.dst)
         mask = np.asarray(self.mask)
-        val = None if self.val is None else np.asarray(self.val)
+        val = (
+            None
+            if self.val is None
+            else jax.tree.map(np.asarray, self.val)
+        )
+        val_leaves, val_def = (
+            (None, None) if val is None else jax.tree.flatten(val)
+        )
         out = []
         for i in range(len(src)):
             if not mask[i]:
@@ -192,8 +224,8 @@ class EdgeBatch:
             if val is None:
                 out.append((int(src[i]), int(dst[i])))
             else:
-                v = val[i]
-                v = v.item() if hasattr(v, "item") else v
+                leaves_i = [leaf[i].item() for leaf in val_leaves]
+                v = jax.tree.unflatten(val_def, leaves_i)
                 out.append((int(src[i]), int(dst[i]), v))
         return out
 

@@ -35,15 +35,9 @@ def occurrence_rank(keys: jax.Array, mask: Optional[jax.Array] = None) -> jax.Ar
     vectorized pass: the k-th occurrence of a key inside a batch can compute its
     running value as ``base[key] + rank``.
     """
-    n = keys.shape[0]
     k = _grouping_key(keys, mask)
     order = jnp.argsort(k, stable=True)
-    ks = k[order]
-    boundary = jnp.concatenate([jnp.ones((1,), bool), ks[1:] != ks[:-1]])
-    pos = jnp.arange(n, dtype=jnp.int32)
-    seg_start = jax.lax.cummax(jnp.where(boundary, pos, 0))
-    rank_sorted = pos - seg_start
-    return jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+    return _rank_from_grouping(order, segment_boundaries(k[order]))
 
 
 def first_occurrence_mask(
@@ -78,6 +72,52 @@ def segment_sum(
         values = jnp.where(mask, values, jnp.zeros_like(values))
         keys = jnp.where(mask, keys, 0)
     return jax.ops.segment_sum(values, keys, num_segments=num_groups)
+
+
+def _rank_from_grouping(order: jax.Array, boundary: jax.Array) -> jax.Array:
+    """Within-group rank (0-based, original order) from a stable grouping
+    ``order`` and the group-start ``boundary`` mask over the sorted keys."""
+    n = order.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(boundary, pos, 0))
+    rank_sorted = pos - seg_start
+    return jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+
+
+def _pair_order(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array]
+) -> Tuple[jax.Array, jax.Array]:
+    """Stable order grouping equal (src, dst) pairs; returns (order, boundary).
+
+    Uses lexsort on (position, dst, grouping-src) so stability is explicit and
+    no int64 composite key is needed.
+    """
+    n = src.shape[0]
+    ks = _grouping_key(src, mask)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    order = jnp.lexsort((pos, dst.astype(jnp.int32), ks))
+    s_sorted = ks[order]
+    d_sorted = dst.astype(jnp.int32)[order]
+    boundary = segment_boundaries(s_sorted) | segment_boundaries(d_sorted)
+    return order, boundary
+
+
+def occurrence_rank_pairs(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """occurrence_rank over composite (src, dst) keys."""
+    order, boundary = _pair_order(src, dst, mask)
+    return _rank_from_grouping(order, boundary)
+
+
+def first_occurrence_mask_pairs(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """True for the first valid occurrence of each (src, dst) pair in the batch."""
+    first = occurrence_rank_pairs(src, dst, mask) == 0
+    if mask is not None:
+        first = first & mask
+    return first
 
 
 def sort_by_key(

@@ -91,6 +91,16 @@ class AdjacencyListGraph:
         self.max_degree = max_degree
         self.nbrs, self.deg = init_table(capacity, max_degree)
 
+    @classmethod
+    def from_state(cls, nbrs, deg) -> "AdjacencyListGraph":
+        """Wrap existing (nbrs, deg) arrays (e.g. a Spanner summary) as a view."""
+        g = cls.__new__(cls)
+        g.capacity = int(nbrs.shape[0])
+        g.max_degree = int(nbrs.shape[1])
+        g.nbrs = nbrs
+        g.deg = deg
+        return g
+
     def reset(self) -> None:
         self.nbrs, self.deg = init_table(self.capacity, self.max_degree)
 

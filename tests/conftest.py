@@ -9,9 +9,6 @@ Must run before jax initializes, hence module-level in conftest.
 import os
 import sys
 
-# Force CPU even when the session env preselects a TPU platform (JAX_PLATFORMS
-# may arrive as "axon" — the tunneled TPU); tests always run on the virtual mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,3 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force CPU even when the session environment preselects a TPU platform (the
+# sitecustomize registers an "axon" PJRT backend and pins it regardless of
+# JAX_PLATFORMS, so the env var alone is not enough — the config update is).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, "expected the virtual 8-device CPU mesh"

@@ -1,0 +1,34 @@
+"""Shared test fixtures: the reference's sample graph and golden helpers.
+
+The 7-edge / 5-vertex fixture mirrors GraphStreamTestUtils.getLongLongEdges
+(test/GraphStreamTestUtils.java:55-68); golden comparisons are order-insensitive
+like Flink's compareResultsByLinesInMemory.
+"""
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+
+LONG_LONG_EDGES = [
+    (1, 2, 12),
+    (1, 3, 13),
+    (2, 3, 23),
+    (3, 4, 34),
+    (3, 5, 35),
+    (4, 5, 45),
+    (5, 1, 51),
+]
+
+CFG = StreamConfig(vertex_capacity=16, max_degree=16, batch_size=4)
+
+
+def long_long_stream(batch_size=None, cfg=CFG):
+    return EdgeStream.from_collection(
+        LONG_LONG_EDGES, cfg, batch_size=batch_size
+    )
+
+
+def assert_lines(output_lines, expected: str):
+    """Order-insensitive golden compare (compareResultsByLinesInMemory analog)."""
+    got = sorted(output_lines)
+    want = sorted(l for l in expected.strip().split("\n") if l)
+    assert got == want, f"\n got: {got}\nwant: {want}"

@@ -1,0 +1,47 @@
+"""Bipartiteness check tests mirroring BipartitenessCheckTest.java goldens."""
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.bipartiteness import BipartitenessCheck
+
+CFG = StreamConfig(vertex_capacity=16, max_degree=16)
+
+BIPARTITE_EDGES = [
+    (1, 2),
+    (1, 3),
+    (1, 4),
+    (4, 5),
+    (4, 7),
+    (4, 9),
+]  # BipartitenessCheckTest.java:70-79
+
+NON_BIPARTITE_EDGES = [
+    (1, 2),
+    (2, 3),
+    (3, 1),
+    (4, 5),
+    (5, 7),
+    (4, 1),
+]  # BipartitenessCheckTest.java:81-90
+
+
+def test_bipartite_golden():
+    stream = EdgeStream.from_collection(BIPARTITE_EDGES, CFG)
+    results = stream.aggregate(BipartitenessCheck(window_ms=500)).collect()
+    assert [str(r[0]) for r in results] == [
+        "(true,{1={1=(1,true), 2=(2,false), 3=(3,false), 4=(4,false), "
+        "5=(5,true), 7=(7,true), 9=(9,true)}})"
+    ]
+
+
+def test_non_bipartite_golden():
+    stream = EdgeStream.from_collection(NON_BIPARTITE_EDGES, CFG)
+    results = stream.aggregate(BipartitenessCheck(window_ms=500)).collect()
+    assert [str(r[0]) for r in results] == ["(false,{})"]
+
+
+def test_bipartite_batched_matches_sequential():
+    for bs in (1, 3, 6):
+        stream = EdgeStream.from_collection(BIPARTITE_EDGES, CFG, batch_size=bs)
+        results = stream.aggregate(BipartitenessCheck(window_ms=500)).collect()
+        assert str(results[-1][0]).startswith("(true,")

@@ -1,0 +1,49 @@
+"""Greedy streaming weighted matching tests
+(CentralizedWeightedMatching.java:68-108 semantics)."""
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
+
+CFG = StreamConfig(vertex_capacity=16, max_degree=16)
+
+
+def test_matching_scenario():
+    edges = [
+        (1, 2, 10),  # ADD (no collisions)
+        (3, 4, 5),  # ADD
+        (2, 3, 100),  # collides with both (sum 15), 100 > 30: evict both, ADD
+        (1, 4, 50),  # endpoints now free: ADD
+        (2, 4, 150),  # collides with (2,3,100) and (1,4,50): 150 <= 300: reject
+    ]
+    algo = CentralizedWeightedMatching()
+    events = algo.run(EdgeStream.from_collection(edges, CFG)).collect()
+    assert events == [
+        ("ADD", 1, 2, 10.0),
+        ("ADD", 3, 4, 5.0),
+        ("REMOVE", 1, 2, 10.0),
+        ("REMOVE", 3, 4, 5.0),
+        ("ADD", 2, 3, 100.0),
+        ("ADD", 1, 4, 50.0),
+    ]
+    assert algo.matched_edges(algo.final_state) == [(1, 4, 50.0), (2, 3, 100.0)]
+
+
+def test_matching_rematch_same_pair():
+    # Re-offering the matched pair with a big weight evicts and re-adds it.
+    edges = [(1, 2, 10), (1, 2, 30)]
+    algo = CentralizedWeightedMatching()
+    events = algo.run(EdgeStream.from_collection(edges, CFG)).collect()
+    assert events == [
+        ("ADD", 1, 2, 10.0),
+        ("REMOVE", 1, 2, 10.0),
+        ("ADD", 1, 2, 30.0),
+    ]
+
+
+def test_matching_weight_not_double_counted_for_same_edge():
+    # (1,2,25) vs matched (1,2,10): sum must be 10 (one collision), not 20.
+    edges = [(1, 2, 10), (1, 2, 25)]
+    algo = CentralizedWeightedMatching()
+    events = algo.run(EdgeStream.from_collection(edges, CFG)).collect()
+    assert ("ADD", 1, 2, 25.0) in events

@@ -1,0 +1,72 @@
+"""Continuous property-stream tests: degrees, vertex/edge counts, getVertices.
+
+Goldens from test/operations/TestGetDegrees.java, TestGetVertices.java,
+TestNumberOfEntities.java — these are *running-update traces* (one record per
+per-key update), which the batched kernels reproduce exactly via in-batch
+occurrence ranking.
+"""
+
+import pytest
+
+from fixtures import assert_lines, long_long_stream
+
+DEGREES_GOLDEN = (
+    "1,1\n1,2\n1,3\n2,1\n2,2\n3,1\n3,2\n3,3\n3,4\n4,1\n4,2\n5,1\n5,2\n5,3"
+)
+IN_DEGREES_GOLDEN = "1,1\n2,1\n3,1\n3,2\n4,1\n5,1\n5,2"
+OUT_DEGREES_GOLDEN = "1,1\n1,2\n2,1\n3,1\n3,2\n4,1\n5,1"
+
+
+@pytest.mark.parametrize("bs", [1, 3, 7])
+def test_get_degrees(bs):
+    # TestGetDegrees.testGetDegrees (:33-60)
+    assert_lines(long_long_stream(batch_size=bs).get_degrees().lines(), DEGREES_GOLDEN)
+
+
+@pytest.mark.parametrize("bs", [1, 7])
+def test_get_in_degrees(bs):
+    # TestGetDegrees.testGetInDegrees (:62-84)
+    assert_lines(
+        long_long_stream(batch_size=bs).get_in_degrees().lines(), IN_DEGREES_GOLDEN
+    )
+
+
+@pytest.mark.parametrize("bs", [1, 7])
+def test_get_out_degrees(bs):
+    # TestGetDegrees.testGetOutDegrees (:86-109)
+    assert_lines(
+        long_long_stream(batch_size=bs).get_out_degrees().lines(), OUT_DEGREES_GOLDEN
+    )
+
+
+def test_get_vertices():
+    # TestGetVertices.java:38-42
+    assert_lines(
+        long_long_stream().get_vertices().lines(),
+        "1,(null)\n2,(null)\n3,(null)\n4,(null)\n5,(null)",
+    )
+
+
+def test_number_of_vertices():
+    # TestNumberOfEntities.testNumberOfVertices (:40-44)
+    assert_lines(
+        long_long_stream().number_of_vertices().lines(), "1\n2\n3\n4\n5"
+    )
+
+
+def test_number_of_edges():
+    # TestNumberOfEntities.testNumberOfEdges (:65-71)
+    assert_lines(
+        long_long_stream().number_of_edges().lines(), "1\n2\n3\n4\n5\n6\n7"
+    )
+
+
+def test_degree_trace_order_within_key():
+    # The per-key degree trace must be monotonically increasing in arrival
+    # order (running updates, not final values).
+    recs = long_long_stream(batch_size=2).get_degrees().collect()
+    per_key = {}
+    for v, d in recs:
+        per_key.setdefault(v, []).append(d)
+    for v, seq in per_key.items():
+        assert seq == list(range(1, len(seq) + 1))

@@ -1,0 +1,92 @@
+"""slice() + neighborhood aggregation tests mirroring TestSlice.java goldens.
+
+All nine combinations of {fold, reduce, apply} x {OUT(default), IN, ALL}
+(TestSlice.java:40-201), with the same user functions: SumEdgeValues fold,
+SumEdgeValuesReduce, and the big/small SumEdgeValuesApply."""
+
+import jax.numpy as jnp
+import pytest
+
+from gelly_streaming_tpu.core.types import EdgeDirection
+
+from fixtures import assert_lines, long_long_stream
+
+FOLD_OUT = "1,25\n2,23\n3,69\n4,45\n5,51"
+FOLD_IN = "1,51\n2,12\n3,36\n4,34\n5,80"
+FOLD_ALL = "1,76\n2,35\n3,105\n4,79\n5,131"
+APPLY_OUT = "1,small\n2,small\n3,big\n4,small\n5,big"
+APPLY_IN = "1,big\n2,small\n3,small\n4,small\n5,big"
+APPLY_ALL = "1,big\n2,small\n3,big\n4,big\n5,big"
+
+
+def _fold(accum, vid, nbr, val):
+    # SumEdgeValues (TestSlice.java:206-214): accum = (vertex id, sum + val)
+    return (vid, accum[1] + val)
+
+
+def _reduce(a, b):
+    return a + b
+
+
+def _apply(vid, nbrs, vals, valid):
+    # SumEdgeValuesApply (TestSlice.java:221-238): sum > 50 -> "big" else "small"
+    s = jnp.sum(jnp.where(valid, vals, 0))
+    return (vid, s > 50)
+
+
+def _post(rec):
+    vid, big = rec
+    return (vid, "big" if big else "small")
+
+
+@pytest.mark.parametrize(
+    "direction,golden",
+    [
+        (EdgeDirection.OUT, FOLD_OUT),
+        (EdgeDirection.IN, FOLD_IN),
+        (EdgeDirection.ALL, FOLD_ALL),
+    ],
+)
+def test_fold_neighbors(direction, golden):
+    out = long_long_stream().slice(1000, direction).fold_neighbors((0, 0), _fold)
+    assert_lines(out.lines(), golden)
+
+
+@pytest.mark.parametrize(
+    "direction,golden",
+    [
+        (EdgeDirection.OUT, FOLD_OUT),
+        (EdgeDirection.IN, FOLD_IN),
+        (EdgeDirection.ALL, FOLD_ALL),
+    ],
+)
+def test_reduce_on_edges(direction, golden):
+    out = long_long_stream().slice(1000, direction).reduce_on_edges(_reduce)
+    assert_lines(out.lines(), golden)
+
+
+@pytest.mark.parametrize(
+    "direction,golden",
+    [
+        (EdgeDirection.OUT, APPLY_OUT),
+        (EdgeDirection.IN, APPLY_IN),
+        (EdgeDirection.ALL, APPLY_ALL),
+    ],
+)
+def test_apply_on_neighbors(direction, golden):
+    out = (
+        long_long_stream()
+        .slice(1000, direction)
+        .apply_on_neighbors(_apply, post=_post)
+    )
+    assert_lines(out.lines(), golden)
+
+
+def test_slice_multi_batch_single_window():
+    # Without timestamps the finite stream forms one pane regardless of batching.
+    out = (
+        long_long_stream(batch_size=2)
+        .slice(1000, EdgeDirection.OUT)
+        .reduce_on_edges(_reduce)
+    )
+    assert_lines(out.lines(), FOLD_OUT)

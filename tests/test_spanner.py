@@ -1,0 +1,32 @@
+"""k-Spanner aggregation tests (library/Spanner.java admission semantics)."""
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.spanner import Spanner
+
+CFG = StreamConfig(vertex_capacity=32, max_degree=8, num_shards=1)
+
+
+def test_spanner_admission_sequence():
+    # The AdjacencyListGraphTest.testBoundedBFS sequence (:58-85) as a stream:
+    # with k=3, edges (3,6) and (5,9) must be dropped, the rest admitted.
+    edges = [
+        (1, 4), (4, 5), (5, 6), (4, 7), (7, 8),
+        (2, 3), (3, 4), (3, 6), (8, 9), (8, 6), (5, 9),
+    ]
+    stream = EdgeStream.from_collection(edges, CFG)
+    results = stream.aggregate(Spanner(window_ms=1000, k=3)).collect()
+    g = results[-1][0]
+    expected = {
+        (1, 4), (4, 5), (5, 6), (4, 7), (7, 8),
+        (2, 3), (3, 4), (8, 9), (6, 8),
+    }
+    assert g.edges() == expected
+
+
+def test_spanner_k1_keeps_all_non_duplicate_edges():
+    # k=1: an edge is dropped only if endpoints are already adjacent.
+    edges = [(1, 2), (2, 3), (1, 2), (1, 3)]
+    stream = EdgeStream.from_collection(edges, CFG)
+    results = stream.aggregate(Spanner(window_ms=1000, k=1)).collect()
+    assert results[-1][0].edges() == {(1, 2), (2, 3), (1, 3)}

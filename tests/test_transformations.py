@@ -1,0 +1,136 @@
+"""EdgeStream transformation tests mirroring test/operations/* golden outputs."""
+
+import jax.numpy as jnp
+
+from gelly_streaming_tpu.core.stream import EdgeStream
+
+from fixtures import CFG, LONG_LONG_EDGES, assert_lines, long_long_stream
+
+
+def test_graph_stream_creation():
+    # TestGraphStreamCreation.java:38-44
+    stream = long_long_stream()
+    assert_lines(
+        stream.edges_csv_lines(),
+        "1,2,12\n1,3,13\n2,3,23\n3,4,34\n3,5,35\n4,5,45\n5,1,51",
+    )
+
+
+def test_map_edges_plus_one():
+    # TestMapEdges.testWithSameValue (:41-47): value + 1
+    stream = long_long_stream().map_edges(lambda s, d, v: v + 1)
+    assert_lines(
+        stream.edges_csv_lines(),
+        "1,2,13\n1,3,14\n2,3,24\n3,4,35\n3,5,36\n4,5,46\n5,1,52",
+    )
+
+
+def test_map_edges_to_tuple():
+    # TestMapEdges tuple-type golden (:65-71): value -> (value, value+1)
+    stream = long_long_stream().map_edges(lambda s, d, v: (v, v + 1))
+    assert_lines(
+        stream.edges_csv_lines(),
+        "1,2,(12,13)\n1,3,(13,14)\n2,3,(23,24)\n3,4,(34,35)\n3,5,(35,36)\n4,5,(45,46)\n5,1,(51,52)",
+    )
+
+
+def test_map_edges_chained():
+    # TestMapEdges chained golden (:88-94): (+1) then tuple
+    stream = (
+        long_long_stream()
+        .map_edges(lambda s, d, v: v + 1)
+        .map_edges(lambda s, d, v: (v, v + 1))
+    )
+    assert_lines(
+        stream.edges_csv_lines(),
+        "1,2,(13,14)\n1,3,(14,15)\n2,3,(24,25)\n3,4,(35,36)\n3,5,(36,37)\n4,5,(46,47)\n5,1,(52,53)",
+    )
+
+
+def test_filter_edges():
+    # TestFilterEdges.testWithSimpleFilter (:40-44): keep value > 20
+    stream = long_long_stream().filter_edges(lambda s, d, v: v > 20)
+    assert_lines(
+        stream.edges_csv_lines(), "2,3,23\n3,4,34\n3,5,35\n4,5,45\n5,1,51"
+    )
+
+
+def test_filter_edges_keep_all():
+    stream = long_long_stream().filter_edges(lambda s, d, v: v > 0)
+    assert len(stream.collect_edges()) == 7
+
+
+def test_filter_edges_discard_all():
+    # TestFilterEdges discard golden (:86): empty
+    stream = long_long_stream().filter_edges(lambda s, d, v: v < 0)
+    assert stream.collect_edges() == []
+
+
+def test_filter_vertices():
+    # TestFilterVertices.testWithSimpleFilter (:40-43): keep vertices > 1
+    stream = long_long_stream().filter_vertices(lambda v: v > 1)
+    assert_lines(stream.edges_csv_lines(), "2,3,23\n3,4,34\n3,5,35\n4,5,45")
+
+
+def test_filter_vertices_discard_all():
+    stream = long_long_stream().filter_vertices(lambda v: v < 0)
+    assert stream.collect_edges() == []
+
+
+def test_reverse():
+    # TestReverse.java:38-44
+    stream = long_long_stream().reverse()
+    assert_lines(
+        stream.edges_csv_lines(),
+        "2,1,12\n3,1,13\n3,2,23\n4,3,34\n5,3,35\n5,4,45\n1,5,51",
+    )
+
+
+def test_undirected():
+    # TestUndirected.java:38-51
+    stream = long_long_stream().undirected()
+    assert_lines(
+        stream.edges_csv_lines(),
+        "1,2,12\n2,1,12\n1,3,13\n3,1,13\n2,3,23\n3,2,23\n3,4,34\n4,3,34\n"
+        "3,5,35\n5,3,35\n4,5,45\n5,4,45\n5,1,51\n1,5,51",
+    )
+
+
+def test_union():
+    # TestUnion.java:41-47: union of two halves restores the full fixture
+    a = EdgeStream.from_collection(LONG_LONG_EDGES[:4], CFG)
+    b = EdgeStream.from_collection(LONG_LONG_EDGES[4:], CFG)
+    assert_lines(
+        a.union(b).edges_csv_lines(),
+        "1,2,12\n1,3,13\n2,3,23\n3,4,34\n3,5,35\n4,5,45\n5,1,51",
+    )
+
+
+def test_distinct():
+    # TestDistinct.java:38-44: duplicated fixture collapses to one copy
+    stream = EdgeStream.from_collection(
+        LONG_LONG_EDGES + LONG_LONG_EDGES, CFG, batch_size=5
+    ).distinct()
+    assert_lines(
+        stream.edges_csv_lines(),
+        "1,2,12\n1,3,13\n2,3,23\n3,4,34\n3,5,35\n4,5,45\n5,1,51",
+    )
+
+
+def test_distinct_within_batch():
+    # duplicates inside one micro-batch are also collapsed
+    stream = EdgeStream.from_collection(
+        [(1, 2, 7), (1, 2, 7), (1, 2, 7), (2, 3, 9)], CFG, batch_size=4
+    ).distinct()
+    assert_lines(stream.edges_csv_lines(), "1,2,7\n2,3,9")
+
+
+def test_transformations_batch_size_invariant():
+    # The same pipeline over batch sizes 1..7 yields identical edge sets.
+    for bs in (1, 2, 3, 7):
+        stream = long_long_stream(batch_size=bs).filter_edges(
+            lambda s, d, v: v > 20
+        )
+        assert_lines(
+            stream.edges_csv_lines(), "2,3,23\n3,4,34\n3,5,35\n4,5,45\n5,1,51"
+        )
