@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Benchmark: streaming Connected Components throughput on the TPU data plane.
+
+The BASELINE.json north-star metric: edges/sec on streaming CC (the reference's
+hot path, SummaryBulkAggregation fold of DisjointSet.union per edge —
+SURVEY.md §3.1).  The reference repo publishes no numbers (BASELINE.md), so the
+baseline is *measured here*: the same edge stream through an optimized native
+single-core CPU union-find (native/edge_parser.cpp cc_baseline — a strictly
+stronger stand-in for the reference's JVM per-edge fold).
+
+Prints ONE JSON line:
+  {"metric": "streaming_cc_edges_per_sec", "value": ..., "unit": "edges/s",
+   "vs_baseline": ...}
+
+Scale knobs via env: GELLY_BENCH_EDGES (default 16M), GELLY_BENCH_VERTICES
+(default 2^20), GELLY_BENCH_BATCH (default 2^16).
+"""
+
+import ctypes
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    num_edges = int(os.environ.get("GELLY_BENCH_EDGES", 1 << 24))
+    capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
+    batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 16))
+
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops import unionfind as uf
+    from gelly_streaming_tpu.utils.metrics import ThroughputMeter
+    from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, capacity, num_edges).astype(np.int32)
+    dst = rng.integers(0, capacity, num_edges).astype(np.int32)
+
+    # ---- TPU streaming fold -------------------------------------------------
+    device = jax.devices()[0]
+    fold = jax.jit(uf.union_edges_with_seen)
+    # Commit every input to the device up front: mixing committed and
+    # uncommitted avals recompiles the kernel on the second call (~10s here).
+    parent = jax.device_put(uf.init_parent(capacity), device)
+    seen = jax.device_put(jnp.zeros((capacity,), bool), device)
+    mask = jax.device_put(jnp.ones((batch,), bool), device)
+
+    # Warmup/compile on the first batch — through the SAME device_put path as
+    # the measured loop (differently-committed arrays would recompile mid-run).
+    parent, seen = fold(
+        parent,
+        seen,
+        jax.device_put(src[:batch], device),
+        jax.device_put(dst[:batch], device),
+        mask,
+    )
+    jax.block_until_ready(parent)
+
+    meter = ThroughputMeter()
+    meter.start()
+    # full batches only: the kernel shape is fixed, a trailing partial batch
+    # would need a differently-shaped mask (and a recompile)
+    for i in range(batch, num_edges - batch + 1, batch):
+        s = jax.device_put(src[i : i + batch], device)
+        d = jax.device_put(dst[i : i + batch], device)
+        parent, seen = fold(parent, seen, s, d, mask)
+        meter.record_batch(batch)
+    jax.block_until_ready(parent)
+    meter.stop()
+    folded_edges = batch * (1 + meter.batches)  # incl. warmup batch
+
+    tpu_eps = meter.edges_per_sec
+    labels_tpu = np.asarray(uf.compress(parent))
+
+    # ---- native CPU baseline (same stream, sequential union-find) ----------
+    lib = load_ingest_lib()
+    vs_baseline = None
+    if lib is not None:
+        cpu_parent = np.arange(capacity, dtype=np.int32)
+        # Baseline on a sample, extrapolated by edges/sec (sequential cost is
+        # linear in edges; sampling keeps total bench time bounded).
+        sample = min(num_edges, 4 << 20)
+        ns = lib.cc_baseline(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            sample,
+            cpu_parent.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            capacity,
+        )
+        cpu_eps = sample / (ns / 1e9)
+        vs_baseline = tpu_eps / cpu_eps
+        # correctness cross-check over exactly the edges the TPU folded
+        check_parent = np.arange(capacity, dtype=np.int32)
+        lib.cc_baseline(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            folded_edges,
+            check_parent.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            capacity,
+        )
+        if not np.array_equal(check_parent, labels_tpu):
+            print(
+                json.dumps({"error": "label mismatch between TPU and CPU baseline"}),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+
+    print(
+        json.dumps(
+            {
+                "metric": "streaming_cc_edges_per_sec",
+                "value": round(tpu_eps, 1),
+                "unit": "edges/s",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Loader for the native (C++) host-plane helpers.
+
+Builds ``native/edge_parser.cpp`` into a shared library on first use (g++ is in
+the image; pybind11 is not, so the boundary is a plain C ABI via ctypes) and
+exposes a typed wrapper.  Falls back cleanly to ``None`` when no compiler is
+available — callers keep a pure-numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libgelly_ingest.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+    except OSError:
+        # source not shipped: use a prebuilt .so if present, else fall back
+        return _SO if os.path.exists(_SO) else None
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
+        return _SO
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+        return None
+
+
+def load_ingest_lib():
+    """The compiled ingest library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.count_rows.argtypes = [ctypes.c_char_p]
+        lib.count_rows.restype = ctypes.c_int64
+        lib.fill_edges.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fill_edges.restype = ctypes.c_int64
+        lib.cc_baseline.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.cc_baseline.restype = ctypes.c_int64
+        _lib = lib
+        return _lib

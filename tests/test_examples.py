@@ -1,0 +1,110 @@
+"""Whole-program example tests (the ITCase tier, SURVEY.md §4.3): each example
+main() runs on temp input/output files; WindowTriangles and DegreeDistribution
+assert the reference ITCase goldens."""
+
+import os
+
+import pytest
+
+from gelly_streaming_tpu.examples import (
+    bipartiteness_check,
+    broadcast_triangle_count,
+    centralized_weighted_matching,
+    connected_components,
+    degree_distribution,
+    exact_triangle_count,
+    incidence_sampling_triangle_count,
+    iterative_connected_components,
+    spanner,
+    window_triangles,
+)
+
+TRIANGLES_DATA = (
+    "1 2 100\n1 3 150\n3 2 200\n2 4 250\n3 4 300\n3 5 350\n4 5 400\n"
+    "4 6 450\n6 5 500\n5 7 550\n6 7 600\n8 6 650\n7 8 700\n7 9 750\n"
+    "8 9 800\n10 8 850\n9 10 900\n9 11 950\n10 11 1000\n"
+)
+
+DEGREES_DATA = "1 2 +\n2 3 +\n1 4 +\n2 3 -\n3 4 +\n1 2 -\n"
+
+
+def _run(module, tmp_path, data, extra_args=()):
+    inp = os.path.join(str(tmp_path), "in.txt")
+    out = os.path.join(str(tmp_path), "out.txt")
+    with open(inp, "w") as f:
+        f.write(data)
+    module.main([inp, out, *extra_args])
+    with open(out) as f:
+        return [l.rstrip("\n") for l in f if l.strip()]
+
+
+def test_window_triangles_itcase(tmp_path):
+    # WindowTrianglesITCase golden: (2,399) (3,799) (2,1199)
+    lines = _run(window_triangles, tmp_path, TRIANGLES_DATA, ["400"])
+    assert sorted(lines) == sorted(["2,399", "3,799", "2,1199"])
+
+
+def test_degree_distribution_itcase(tmp_path):
+    # DegreeDistributionITCase golden (ExamplesTestData.DEGREES_RESULT)
+    lines = _run(degree_distribution, tmp_path, DEGREES_DATA)
+    expected = [
+        "1,1", "1,2",
+        "2,1", "1,1", "1,2",
+        "2,2", "1,1", "1,2",
+        "1,3", "2,1", "1,2",
+        "1,3", "2,2", "1,2",
+        "1,3", "2,1", "1,2",
+    ]
+    assert lines == expected
+
+
+def test_connected_components_example(tmp_path):
+    lines = _run(
+        connected_components, tmp_path, "1 2\n2 3\n5 6\n", ["1000"]
+    )
+    assert lines == ["1,1 2 3", "5,5 6"]
+
+
+def test_connected_components_tree_example(tmp_path):
+    lines = _run(
+        connected_components, tmp_path, "1 2\n2 3\n5 6\n", ["1000", "--tree"]
+    )
+    assert lines == ["1,1 2 3", "5,5 6"]
+
+
+def test_bipartiteness_example(tmp_path):
+    lines = _run(bipartiteness_check, tmp_path, "1 2\n2 3\n3 1\n")
+    assert lines == ["(false,{})"]
+
+
+def test_spanner_example(tmp_path):
+    lines = _run(spanner, tmp_path, "1 2\n2 3\n1 3\n", ["1000", "2"])
+    assert lines == ["1,2", "2,3"]
+
+
+def test_exact_triangle_count_example(tmp_path):
+    lines = _run(exact_triangle_count, tmp_path, "1 2\n2 3\n1 3\n")
+    assert lines[-1] == "-1,1"  # global count reaches 1
+
+
+def test_iterative_cc_example(tmp_path):
+    lines = _run(iterative_connected_components, tmp_path, "1 2\n2 3\n")
+    assert "3,1" in lines
+
+
+def test_sampling_examples_run(tmp_path):
+    data = "".join(f"{i} {j}\n" for i in range(6) for j in range(i + 1, 6))
+    lines = _run(broadcast_triangle_count, tmp_path, data, ["64"])
+    assert len(lines) >= 1
+    lines = _run(incidence_sampling_triangle_count, tmp_path, data, ["64"])
+    assert len(lines) >= 1
+
+
+def test_matching_example(tmp_path):
+    lines = _run(centralized_weighted_matching, tmp_path, "1 2 10\n3 4 20\n")
+    assert lines == ["ADD,1,2,10.0", "ADD,3,4,20.0"]
+
+
+def test_example_usage_error():
+    with pytest.raises(SystemExit):
+        exact_triangle_count.main(["a", "b", "c", "d", "e"])
