@@ -30,7 +30,9 @@ import numpy as np
 def main():
     num_edges = int(os.environ.get("GELLY_BENCH_EDGES", 1 << 24))
     capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
-    batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 16))
+    # 2^18 sits at the measured sweet spot of the host->device transfer
+    # pipeline (larger batches exceed the tunnel's profitable transfer size)
+    batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 18))
 
     import jax
     import jax.numpy as jnp

@@ -17,6 +17,7 @@ operator state is not checkpointed (SURVEY.md §5.3-4).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Iterator, Optional
 
 import jax
@@ -85,15 +86,33 @@ class SummaryAggregation:
             self._combine_cache = jax.jit(self.combine)
         return self._combine_cache
 
-    def run(self, stream) -> OutputStream:
+    def run(
+        self,
+        stream,
+        checkpoint_path: Optional[str] = None,
+        restore: bool = True,
+    ) -> OutputStream:
         """Execute over an EdgeStream (entered via GraphStream.aggregate,
-        GraphStream.java:139-140 / SimpleEdgeStream.java:100-102)."""
+        GraphStream.java:139-140 / SimpleEdgeStream.java:100-102).
+
+        With ``checkpoint_path``, the running summary is snapshot after every
+        window close and restored on start — the Merger's ListCheckpointed
+        behavior (SummaryAggregation.java:127-135), generalized to the whole
+        summary pytree (closing the reference's unsaved-state gap)."""
         cfg = stream.cfg
         window_ms = self.window_ms or cfg.window_ms
         n_parts = self._num_partitions(cfg)
 
         def records() -> Iterator[tuple]:
             running = None
+            if checkpoint_path and restore:
+                from gelly_streaming_tpu.utils.checkpoint import (
+                    checkpoint_exists,
+                    load_state,
+                )
+
+                if checkpoint_exists(checkpoint_path):
+                    running = load_state(checkpoint_path, self.initial_state(cfg))
             for pane in assign_tumbling_windows(stream.batches(), window_ms):
                 partials = []
                 for part in range(n_parts):
@@ -136,6 +155,10 @@ class SummaryAggregation:
                 else:
                     running = self._combine_j(running, pane_summary)
                 out = self.transform(running)
+                if checkpoint_path:
+                    from gelly_streaming_tpu.utils.checkpoint import save_state
+
+                    save_state(checkpoint_path, running)
                 yield out if isinstance(out, tuple) else (out,)
                 if self.transient_state:
                     running = None

@@ -28,14 +28,21 @@ from gelly_streaming_tpu.core.windows import WindowPane, assign_tumbling_windows
 
 
 class Neighborhoods:
-    """A closed pane grouped by key: padded [K, D] neighbor/value tensors."""
+    """A closed pane grouped by key: padded [K, D] neighbor/value tensors.
 
-    def __init__(self, pane: WindowPane, keys, nbrs, vals, valid):
+    K and D are rounded up to powers of two so successive panes of similar
+    size reuse the same compiled kernels (per-pane exact shapes would
+    recompile every window).  Rows beyond ``num_keys`` are padding with an
+    all-False valid mask; emission honors ``num_keys``.
+    """
+
+    def __init__(self, pane: WindowPane, keys, nbrs, vals, valid, num_keys):
         self.pane = pane
-        self.keys = keys  # np [K]
-        self.nbrs = nbrs  # np [K, D]
-        self.vals = vals  # None or pytree of np [K, D]
-        self.valid = valid  # np [K, D] bool
+        self.keys = keys  # np [K_padded]
+        self.nbrs = nbrs  # np [K_padded, D_padded]
+        self.vals = vals  # None or pytree of np [K_padded, D_padded]
+        self.valid = valid  # np [K_padded, D_padded] bool
+        self.num_keys = num_keys  # real key count (rows beyond are padding)
 
 
 def _build_neighborhoods(
@@ -59,22 +66,27 @@ def _build_neighborhoods(
     v = None if val is None else jax.tree.map(lambda a: a[order], val)
     keys, starts, counts = np.unique(s, return_index=True, return_counts=True)
     k_n, d_max = len(keys), int(counts.max())
-    nbrs = np.zeros((k_n, d_max), np.int32)
-    valid = np.zeros((k_n, d_max), bool)
+    # power-of-two shape buckets -> bounded set of compiled kernel shapes
+    k_pad = max(1, 1 << (k_n - 1).bit_length())
+    d_pad = max(1, 1 << (d_max - 1).bit_length())
+    nbrs = np.zeros((k_pad, d_pad), np.int32)
+    valid = np.zeros((k_pad, d_pad), bool)
     col = np.arange(len(s)) - starts.repeat(counts)
     row = np.arange(k_n).repeat(counts)
     nbrs[row, col] = d
     valid[row, col] = True
+    keys_pad = np.zeros((k_pad,), np.int32)
+    keys_pad[:k_n] = keys
     vals = None
     if v is not None:
 
         def scatter(a):
-            out = np.zeros((k_n, d_max), a.dtype)
+            out = np.zeros((k_pad, d_pad), a.dtype)
             out[row, col] = a
             return out
 
         vals = jax.tree.map(scatter, v)
-    return Neighborhoods(pane, keys.astype(np.int32), nbrs, vals, valid)
+    return Neighborhoods(pane, keys_pad, nbrs, vals, valid, k_n)
 
 
 class SnapshotStream:
@@ -128,7 +140,7 @@ class SnapshotStream:
                 )
                 leaves = [np.asarray(x) for x in jax.tree.leaves(accums)]
                 treedef = jax.tree.structure(accums)
-                for i in range(len(hood.keys)):
+                for i in range(hood.num_keys):
                     rec = jax.tree.unflatten(
                         treedef, [leaf[i].item() for leaf in leaves]
                     )
@@ -182,11 +194,11 @@ class SnapshotStream:
                 )
                 leaves = [np.asarray(x) for x in jax.tree.leaves(out)]
                 treedef = jax.tree.structure(out)
-                for i, k in enumerate(hood.keys):
+                for i in range(hood.num_keys):
                     rec = jax.tree.unflatten(
                         treedef, [leaf[i].item() for leaf in leaves]
                     )
-                    yield (int(k), rec)
+                    yield (int(hood.keys[i]), rec)
 
         return OutputStream(records)
 
@@ -214,7 +226,7 @@ class SnapshotStream:
                 )
                 leaves = [np.asarray(x) for x in jax.tree.leaves(out)]
                 treedef = jax.tree.structure(out)
-                for i in range(len(hood.keys)):
+                for i in range(hood.num_keys):
                     rec = jax.tree.unflatten(
                         treedef, [leaf[i].item() for leaf in leaves]
                     )

@@ -346,6 +346,135 @@ class EdgeStream:
 
         return OutputStream(records)
 
+    def get_edges(self) -> OutputStream:
+        """The edge stream itself as records (GraphStream.getEdges)."""
+
+        def records():
+            for batch in self.batches():
+                for t in batch.to_tuples():
+                    yield t
+
+        return OutputStream(records)
+
+    def keyed_aggregate(
+        self,
+        edge_expand: Callable,
+        state_init: Callable,
+        vertex_update: Callable,
+    ) -> OutputStream:
+        """Generic keyed aggregation — the reference's
+        ``aggregate(edgeMapper, vertexMapper)`` (SimpleEdgeStream.java:489-494:
+        flatMap -> keyBy(0) -> stateful map), array-form:
+
+          edge_expand(src, dst, val) -> (keys [M, B], vals pytree of [M, B])
+              vectorized flatMap emitting M records per edge (static M);
+          state_init(cfg) -> dense per-key state pytree (arrays over [0, C));
+          vertex_update(state, keys [N], vals [N], mask [N])
+              -> (state, out pytree of [N], out_mask [N])
+              batched keyed update; use ops.segments.occurrence_rank for
+              running per-key semantics within a batch.
+
+        Returns the (key, out...) record stream.
+        """
+        cfg = self.cfg
+
+        def kernel(state, batch):
+            keys, vals = edge_expand(batch.src, batch.dst, batch.val)
+            m = keys.shape[0]
+            flat_keys = keys.reshape(-1)
+            flat_vals = jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), vals)
+            flat_mask = jnp.tile(batch.mask, (m, 1)).reshape(-1)
+            state, out, out_mask = vertex_update(
+                state, flat_keys, flat_vals, flat_mask
+            )
+            return state, flat_keys, out, out_mask
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            state = state_init(cfg)
+            for batch in self.batches():
+                state, keys, out, out_mask = kernel(state, batch)
+                k_h = np.asarray(keys)
+                m_h = np.asarray(out_mask)
+                leaves = [np.asarray(x) for x in jax.tree.leaves(out)]
+                treedef = jax.tree.structure(out)
+                for i in np.nonzero(m_h)[0]:
+                    rec = jax.tree.unflatten(
+                        treedef, [leaf[i].item() for leaf in leaves]
+                    )
+                    if isinstance(rec, tuple):
+                        yield (int(k_h[i]),) + rec
+                    else:
+                        yield (int(k_h[i]), rec)
+
+        return OutputStream(records)
+
+    def global_aggregate(
+        self,
+        update: Callable,
+        initial_state: Callable,
+        result: Callable,
+        emit_on_change: bool = True,
+    ) -> OutputStream:
+        """Centralized (parallelism-1 analog) aggregation with change-dedup
+        (SimpleEdgeStream.java:505-519 + GlobalAggregateMapper :562-576).
+
+        update(state, batch) -> state (jitted once); result(state) -> host
+        value; a record is emitted per batch only when the result changes
+        (always, when emit_on_change=False).
+        """
+        cfg = self.cfg
+        update_j = jax.jit(update)
+
+        def records():
+            state = initial_state(cfg)
+            prev = None
+            for batch in self.batches():
+                state = update_j(state, batch)
+                res = result(state)
+                if not emit_on_change or res != prev:
+                    yield res if isinstance(res, tuple) else (res,)
+                    prev = res
+
+        return OutputStream(records)
+
+    def build_neighborhood(self, directed: bool = False) -> OutputStream:
+        """Continuous adjacency stream (SimpleEdgeStream.java:531-560): emits
+        (src, dst, sorted-neighbors-of-src) per arriving edge, with adjacency
+        state as of the end of the edge's micro-batch (the reference's per-key
+        TreeSet trace is recovered exactly at batch_size=1).
+
+        directed=False mirrors the reference default: the stream is made
+        undirected first, so each edge contributes both directions.
+        """
+        cfg = self.cfg
+        base = self if directed else self.undirected()
+
+        def kernel(table, batch):
+            table, _ = neighbors.insert_unique_batch(
+                table, batch.src, batch.dst, batch.mask
+            )
+            rows, valid = neighbors.gather_rows(table, batch.src)
+            return table, rows, valid
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            table = neighbors.init_table(cfg.vertex_capacity, cfg.max_degree)
+            for batch in base.batches():
+                table, rows, valid = kernel(table, batch)
+                s_h = np.asarray(batch.src)
+                d_h = np.asarray(batch.dst)
+                m_h = np.asarray(batch.mask)
+                r_h = np.asarray(rows)
+                v_h = np.asarray(valid)
+                for i in np.nonzero(m_h)[0]:
+                    nbrs = tuple(sorted(int(x) for x in r_h[i][v_h[i]]))
+                    yield (int(s_h[i]), int(d_h[i]), nbrs)
+
+        return OutputStream(records)
+
     # ---- windows & aggregations (defined in sibling modules) ----------------
 
     def slice(self, window_ms: Optional[int] = None, direction: EdgeDirection = EdgeDirection.OUT):

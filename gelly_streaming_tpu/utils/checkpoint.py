@@ -19,18 +19,33 @@ import jax
 import numpy as np
 
 
+def _normalize(path: str) -> str:
+    """np.savez appends .npz to bare paths; make that explicit everywhere so
+    exists()-checks and load paths agree with what save actually wrote."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_state(path: str, state: Any) -> None:
-    """Snapshot any pytree-of-arrays state to ``path`` (.npz)."""
+    """Snapshot any pytree-of-arrays state to ``path`` (.npz), atomically:
+    a crash mid-save must never destroy the previous good snapshot."""
+    path = _normalize(path)
     leaves, treedef = jax.tree.flatten(state)
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path, __treedef__=np.frombuffer(
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, __treedef__=np.frombuffer(
         json.dumps(_treedef_token(state)).encode(), dtype=np.uint8
     ), **arrays)
+    os.replace(tmp, path)
+
+
+def checkpoint_exists(path: str) -> bool:
+    return os.path.exists(_normalize(path))
 
 
 def load_state(path: str, like: Any) -> Any:
     """Restore a snapshot into the structure of ``like`` (same pytree shape)."""
+    path = _normalize(path)
     with np.load(path) as data:
         leaves_like, treedef = jax.tree.flatten(like)
         n = len(leaves_like)
