@@ -8,19 +8,24 @@ baseline is *measured here*: the same edge stream through an optimized native
 single-core CPU union-find (native/edge_parser.cpp cc_baseline — a strictly
 stronger stand-in for the reference's JVM per-edge fold).
 
+Pipeline under test (the framework's real ingest path):
+  host pack (native wire format, io/wire.py) -> prefetched device_put ->
+  jitted unpack+union-find fold (donated state) per micro-batch.
+The host->device link is the bottleneck, so the wire format's bytes/edge and
+the prefetch depth set the ceiling; device compute alone sustains ~8B edges/s.
+
 Prints ONE JSON line:
   {"metric": "streaming_cc_edges_per_sec", "value": ..., "unit": "edges/s",
    "vs_baseline": ...}
 
 Scale knobs via env: GELLY_BENCH_EDGES (default 16M), GELLY_BENCH_VERTICES
-(default 2^20), GELLY_BENCH_BATCH (default 2^16).
+(default 2^20), GELLY_BENCH_BATCH (default 2^18).
 """
 
 import ctypes
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -35,8 +40,8 @@ def main():
     batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 18))
 
     import jax
-    import jax.numpy as jnp
 
+    from gelly_streaming_tpu.io import wire
     from gelly_streaming_tpu.ops import unionfind as uf
     from gelly_streaming_tpu.utils.metrics import ThroughputMeter
     from gelly_streaming_tpu.utils.native import load_ingest_lib
@@ -47,36 +52,42 @@ def main():
 
     # ---- TPU streaming fold -------------------------------------------------
     device = jax.devices()[0]
-    fold = jax.jit(uf.union_edges_with_seen)
-    # Commit every input to the device up front: mixing committed and
-    # uncommitted avals recompiles the kernel on the second call (~10s here).
+    width = wire.width_for_capacity(capacity)
+
+    def fold_wire(parent, seen, wire_buf):
+        s, d = wire.unpack_edges(wire_buf, batch, width)
+        return uf.union_edges_with_seen(parent, seen, s, d, None)
+
+    # Donate the summary state: the fold updates parent/seen in place on
+    # device instead of allocating fresh HBM buffers every micro-batch.
+    fold = jax.jit(fold_wire, donate_argnums=(0, 1))
+
+    import jax.numpy as jnp
+
     parent = jax.device_put(uf.init_parent(capacity), device)
     seen = jax.device_put(jnp.zeros((capacity,), bool), device)
-    mask = jax.device_put(jnp.ones((batch,), bool), device)
 
-    # Warmup/compile on the first batch — through the SAME device_put path as
-    # the measured loop (differently-committed arrays would recompile mid-run).
-    parent, seen = fold(
-        parent,
-        seen,
-        jax.device_put(src[:batch], device),
-        jax.device_put(dst[:batch], device),
-        mask,
-    )
+    # full batches only: the kernel shape is fixed, a trailing partial batch
+    # would need a differently-shaped unpack (and a recompile)
+    n_batches = num_edges // batch
+
+    # Warmup/compile on the first batch through the same wire path.
+    w0 = jax.device_put(wire.pack_edges(src[:batch], dst[:batch], width), device)
+    parent, seen = fold(parent, seen, w0)
     jax.block_until_ready(parent)
+
+    def batches():
+        for i in range(1, n_batches):
+            yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
 
     meter = ThroughputMeter()
     meter.start()
-    # full batches only: the kernel shape is fixed, a trailing partial batch
-    # would need a differently-shaped mask (and a recompile)
-    for i in range(batch, num_edges - batch + 1, batch):
-        s = jax.device_put(src[i : i + batch], device)
-        d = jax.device_put(dst[i : i + batch], device)
-        parent, seen = fold(parent, seen, s, d, mask)
-        meter.record_batch(batch)
+    for wire_buf, n in wire.WirePrefetcher(batches(), width, device, depth=8):
+        parent, seen = fold(parent, seen, wire_buf)
+        meter.record_batch(n)
     jax.block_until_ready(parent)
     meter.stop()
-    folded_edges = batch * (1 + meter.batches)  # incl. warmup batch
+    folded_edges = batch * n_batches  # incl. warmup batch
 
     tpu_eps = meter.edges_per_sec
     labels_tpu = np.asarray(uf.compress(parent))

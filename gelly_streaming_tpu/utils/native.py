@@ -75,5 +75,16 @@ def load_ingest_lib():
             ctypes.c_int32,
         ]
         lib.cc_baseline.restype = ctypes.c_int64
+        # A prebuilt .so may predate newer symbols; bind them only when present
+        # so callers can keep their pure-numpy fallbacks instead of crashing.
+        if hasattr(lib, "pack_edges"):
+            lib.pack_edges.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.pack_edges.restype = ctypes.c_int64
         _lib = lib
         return _lib

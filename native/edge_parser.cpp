@@ -157,6 +157,48 @@ int64_t fill_edges(const char* path, int64_t* src, int64_t* dst, double* val,
   return row;
 }
 
+// Pack a (src, dst) edge batch into the compact device wire format: the src
+// block then the dst block, each id truncated to `width` little-endian bytes
+// (width in {2, 3, 4}; callers pick the narrowest width that covers the
+// stream's vertex capacity).  The host->device link is the streaming data
+// plane's bottleneck, so bytes-per-edge is the throughput ceiling; this is the
+// native fast path behind gelly_streaming_tpu/io/wire.py.
+int64_t pack_edges(const int32_t* src, const int32_t* dst, int64_t n,
+                   int32_t width, uint8_t* out) {
+  if (width < 1 || width > 4) return -1;
+  const int32_t* blocks[2] = {src, dst};
+  uint8_t* q = out;
+  for (const int32_t* block : blocks) {
+    switch (width) {
+      case 4:
+        memcpy(q, block, n * 4);
+        q += n * 4;
+        break;
+      case 3:
+        for (int64_t i = 0; i < n; ++i) {
+          uint32_t v = static_cast<uint32_t>(block[i]);
+          q[0] = v & 0xFF;
+          q[1] = (v >> 8) & 0xFF;
+          q[2] = (v >> 16) & 0xFF;
+          q += 3;
+        }
+        break;
+      case 2:
+        for (int64_t i = 0; i < n; ++i) {
+          uint32_t v = static_cast<uint32_t>(block[i]);
+          q[0] = v & 0xFF;
+          q[1] = (v >> 8) & 0xFF;
+          q += 2;
+        }
+        break;
+      case 1:
+        for (int64_t i = 0; i < n; ++i) *q++ = block[i] & 0xFF;
+        break;
+    }
+  }
+  return q - out;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
