@@ -1,0 +1,60 @@
+"""Pallas MXU triangle kernel: exactness against a dense numpy reference.
+
+On CPU (the test mesh) the kernel runs in Pallas interpret mode — same program
+the TPU compiles, executed by the interpreter — so these tests validate the
+kernel logic itself, not just a fallback path.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.ops import pallas_triangles
+
+
+def _dense_reference(adj: np.ndarray) -> int:
+    a = adj.astype(np.int64)
+    return int(np.sum(a * (a @ a)) // 6)
+
+
+@pytest.mark.parametrize(
+    "n,p,seed", [(30, 0.3, 0), (128, 0.1, 1), (200, 0.05, 2), (257, 0.2, 3)]
+)
+def test_matches_dense_reference(n, p, seed):
+    rng = np.random.default_rng(seed)
+    upper = np.triu(rng.random((n, n)) < p, 1)
+    adj = upper | upper.T
+    u, v = np.nonzero(upper)
+    got = pallas_triangles.pane_triangles_dense(
+        u.astype(np.int32), v.astype(np.int32), n
+    )
+    assert got == _dense_reference(adj)
+
+
+def test_empty_and_triangle_free():
+    assert pallas_triangles.pane_triangles_dense(
+        np.array([], np.int32), np.array([], np.int32), 0
+    ) == 0
+    # a path graph has no triangles
+    u = np.arange(10, dtype=np.int32)
+    v = u + 1
+    assert pallas_triangles.pane_triangles_dense(u, v, 11) == 0
+
+
+def test_single_triangle_and_k4():
+    u = np.array([0, 0, 1], np.int32)
+    v = np.array([1, 2, 2], np.int32)
+    assert pallas_triangles.pane_triangles_dense(u, v, 3) == 1
+    # K4 has 4 triangles
+    uu, vv = zip(*[(a, b) for a in range(4) for b in range(a + 1, 4)])
+    assert pallas_triangles.pane_triangles_dense(
+        np.array(uu, np.int32), np.array(vv, np.int32), 4
+    ) == 4
+
+
+def test_rejects_unpadded_shapes():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        pallas_triangles.triangle_count_dense(
+            jnp.zeros((100, 100), jnp.bfloat16), interpret=True
+        )
