@@ -1,0 +1,318 @@
+"""Multi-host watermark agreement: pane closes gated on the slowest host.
+
+The reference gets this from Flink's min-watermark propagation (a window fires
+only when every input channel's watermark passed its end); here the ingest
+hosts agree through a watermark board (parallel/multihost.py).  The tests run
+N ingest threads in one process — the MiniCluster analog — and assert the
+straggler-safety, share-alignment, and determinism properties the protocol
+must provide, for both the async-board and lockstep-collective transports.
+"""
+
+import threading
+import time as _time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.types import EdgeBatch
+from gelly_streaming_tpu.parallel import multihost as mh
+
+
+def _batches(edges, batch_size=4):
+    """[(src, dst, t), ...] -> EdgeBatch iterator with event time."""
+    for i in range(0, len(edges), batch_size):
+        chunk = edges[i : i + batch_size]
+        yield EdgeBatch.from_edges(
+            [(s, d, 0.0, t) for (s, d, t) in chunk],
+            pad_to=batch_size,
+            with_time=True,
+        )
+
+
+def _host_edges(host_id, pane_ids, per_pane=3, window_ms=100):
+    """Deterministic disjoint edge share per host: pane w gets vertices
+    host_id*1000 + w*10 + k."""
+    out = []
+    for w in pane_ids:
+        for k in range(per_pane):
+            v = host_id * 1000 + w * 10 + k
+            out.append((v, v + 1, w * window_ms + 5 + k))
+    return out
+
+
+def _run_hosts(host_pane_ids, window_ms=100, delays=None):
+    """Run one ingest thread per host; returns per-host closed WindowPanes."""
+    num_hosts = len(host_pane_ids)
+    board = mh.ProcessWatermarkBoard(num_hosts)
+    results = {h: [] for h in range(num_hosts)}
+    errors = []
+
+    def work(h):
+        try:
+            delay = (delays or {}).get(h, 0.0)
+            edges = _host_edges(h, host_pane_ids[h], window_ms=window_ms)
+
+            def delayed():
+                for b in _batches(edges):
+                    if delay:
+                        _time.sleep(delay)
+                    yield b
+
+            for pane in mh.multihost_tumbling_windows(
+                delayed(), window_ms, h, board, timeout=30.0
+            ):
+                results[h].append(pane)
+        except BaseException as e:  # surfaced in the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(h,)) for h in range(num_hosts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "ingest thread hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+@pytest.mark.parametrize("num_hosts", [2, 3])
+def test_all_hosts_close_same_panes_in_order(num_hosts):
+    results = _run_hosts([range(4)] * num_hosts)
+    for h, panes in results.items():
+        assert [p.window_id for p in panes] == [0, 1, 2, 3]
+        for p in panes:
+            # each host's share holds exactly its own edges for that pane
+            assert p.num_edges == 3
+            assert all(v // 1000 == h for v in p.src)
+            assert all((v % 1000) // 10 == p.window_id for v in p.src)
+
+
+def test_empty_shares_keep_pane_sequences_aligned():
+    """A host with gaps in its panes still emits the full pane-id sequence
+    (empty shares), so positional pairing across hosts stays correct."""
+    results = _run_hosts([[0, 1, 2, 3], [0, 3]])
+    assert [p.window_id for p in results[0]] == [0, 1, 2, 3]
+    assert [p.window_id for p in results[1]] == [0, 1, 2, 3]
+    assert [p.num_edges for p in results[1]] == [3, 0, 0, 3]
+
+
+def test_straggler_holds_back_closes():
+    """A slow host must delay everyone's pane closes (no early firing)."""
+    board = mh.ProcessWatermarkBoard(2)
+    fast_closed = []
+
+    def fast():
+        for pane in mh.multihost_tumbling_windows(
+            _batches(_host_edges(0, range(3))), 100, 0, board, timeout=30.0
+        ):
+            fast_closed.append((pane.window_id, _time.monotonic()))
+
+    t = threading.Thread(target=fast)
+    t.start()
+    _time.sleep(0.3)
+    # the fast host has consumed its whole stream, but host 1 has not reported:
+    # nothing may have closed yet
+    assert fast_closed == []
+    t_release = _time.monotonic()
+    for pane in mh.multihost_tumbling_windows(
+        _batches(_host_edges(1, range(3))), 100, 1, board, timeout=30.0
+    ):
+        pass
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    assert [w for w, _ in fast_closed] == [0, 1, 2]
+    assert all(ts >= t_release for _, ts in fast_closed)
+
+
+def test_out_of_order_batch_does_not_regress_watermark():
+    """A batch whose max time is below the host watermark must not crash or
+    deadlock peers (the watermark clamps, matching the single-host path)."""
+    results = _run_hosts(
+        [[2, 1, 0, 3], [0, 1, 2, 3]],  # host 0 ingests panes out of order
+        delays={1: 0.01},
+    )
+    # host 0's early watermark=2 means panes 0,1 may close before their edges
+    # arrive; those arrivals are dropped as late, never corrupting the
+    # sequence alignment
+    assert [p.window_id for p in results[0]] == [0, 1, 2, 3]
+    assert [p.window_id for p in results[1]] == [0, 1, 2, 3]
+
+
+def test_late_edges_dropped_with_hook():
+    board = mh.ProcessWatermarkBoard(1)
+    late = []
+    edges = _host_edges(0, [2]) + _host_edges(0, [0]) + _host_edges(0, [3])
+    panes = list(
+        mh.multihost_tumbling_windows(
+            _batches(edges, batch_size=3),
+            100,
+            0,
+            board,
+            timeout=10.0,
+            on_late=lambda wid, n: late.append((wid, n)),
+        )
+    )
+    # single host: watermark hits 2 after the first batch; pane-0 edges in the
+    # second batch are behind the watermark but pane 0 has NOT closed yet
+    # (closes need watermark > pane id via a later batch), so whether they are
+    # late depends on when pane 0 closed
+    assert [p.window_id for p in panes] == [0, 1, 2, 3]
+    total_emitted = sum(p.num_edges for p in panes)
+    total_late = sum(n for _, n in late)
+    assert total_emitted + total_late == 9
+
+
+def test_crashing_host_releases_peers():
+    """A host whose source raises must still report END (finally), so peers
+    finish instead of deadlocking in wait_global."""
+    board = mh.ProcessWatermarkBoard(2)
+    peer_panes = []
+    errors = []
+
+    def failing_source():
+        yield from _batches(_host_edges(0, [0]))
+        raise IOError("source died")
+
+    def crasher():
+        try:
+            for _ in mh.multihost_tumbling_windows(
+                failing_source(), 100, 0, board, timeout=10.0
+            ):
+                pass
+        except IOError:
+            pass
+        except BaseException as e:
+            errors.append(e)
+
+    def peer():
+        try:
+            for pane in mh.multihost_tumbling_windows(
+                _batches(_host_edges(1, [0, 1])), 100, 1, board, timeout=10.0
+            ):
+                peer_panes.append(pane.window_id)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=crasher), threading.Thread(target=peer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads), "peer deadlocked"
+    assert not errors
+    assert peer_panes == [0, 1]
+
+
+def test_empty_share_carries_value_structure():
+    """Empty shares of a valued stream keep zero-length value arrays."""
+    board = mh.ProcessWatermarkBoard(1)
+
+    def batches():
+        yield EdgeBatch.from_edges(
+            [(1, 2, 7.5, 10), (3, 4, 2.5, 210)], pad_to=2, with_time=True
+        )
+
+    panes = list(
+        mh.multihost_tumbling_windows(batches(), 100, 0, board, timeout=10.0)
+    )
+    assert [p.window_id for p in panes] == [0, 1, 2]
+    middle = panes[1]
+    assert middle.num_edges == 0
+    assert middle.val is not None and len(np.asarray(middle.val)) == 0
+    assert middle.time is not None and len(middle.time) == 0
+
+
+def test_watermark_board_rejects_regression():
+    board = mh.ProcessWatermarkBoard(2)
+    board.report(0, 5)
+    with pytest.raises(ValueError):
+        board.report(0, 3)
+
+
+def test_requires_event_time():
+    board = mh.ProcessWatermarkBoard(1)
+    batches = [
+        EdgeBatch.from_edges([(1, 2), (3, 4)], pad_to=2, with_time=False)
+    ]
+    with pytest.raises(ValueError, match="event timestamps"):
+        list(mh.multihost_tumbling_windows(iter(batches), 100, 0, board))
+
+
+# ---------------------------------------------------------------------------
+# lockstep (collective) transport
+# ---------------------------------------------------------------------------
+
+
+class _BarrierAllgather:
+    """Thread-barrier allgather with the semantics of process_allgather."""
+
+    def __init__(self, num_hosts):
+        self._n = num_hosts
+        self._vals = [None] * num_hosts
+        self._barrier = threading.Barrier(num_hosts)
+        self._tls = threading.local()
+
+    def bind(self, host_id):
+        self._tls.host_id = host_id
+        return self._call
+
+    def _call(self, local):
+        self._vals[self._tls.host_id] = int(local)
+        self._barrier.wait(timeout=30.0)
+        out = np.array(self._vals, np.int64)
+        self._barrier.wait(timeout=30.0)  # protect _vals from the next round
+        return out
+
+
+def _run_lockstep(host_pane_ids, window_ms=100):
+    num_hosts = len(host_pane_ids)
+    ag = _BarrierAllgather(num_hosts)
+    results = {h: [] for h in range(num_hosts)}
+    errors = []
+
+    def work(h):
+        try:
+            edges = _host_edges(h, host_pane_ids[h], window_ms=window_ms)
+            for pane in mh.lockstep_tumbling_windows(
+                _batches(edges), window_ms, ag.bind(h)
+            ):
+                results[h].append(pane)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(h,)) for h in range(num_hosts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "lockstep thread hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+def test_lockstep_equal_streams():
+    results = _run_lockstep([range(3)] * 2)
+    for h in (0, 1):
+        assert [p.window_id for p in results[h]] == [0, 1, 2]
+        assert all(p.num_edges == 3 for p in results[h])
+
+
+def test_lockstep_unequal_batch_counts():
+    """A host with fewer batches END-pads the collective; sequences align."""
+    results = _run_lockstep([[0, 1, 2, 3, 4], [1, 2]])
+    assert [p.window_id for p in results[0]] == [0, 1, 2, 3, 4]
+    assert [p.window_id for p in results[1]] == [0, 1, 2, 3, 4]
+    assert [p.num_edges for p in results[1]] == [0, 3, 3, 0, 0]
+
+
+def test_jax_board_single_process_identity():
+    """process_allgather degenerates to a 1-vector in a single-process run."""
+    board = mh.JaxWatermarkBoard()
+    np.testing.assert_array_equal(board.allgather(7), np.array([7]))
+
+
+def test_distributed_env_single_process():
+    env = mh.distributed_env()
+    assert env == mh.HostEnv(0, 1)
