@@ -32,6 +32,10 @@ _EXPORTS = {
     "StreamConfig": ("gelly_streaming_tpu.core.config", "StreamConfig"),
     "EdgeStream": ("gelly_streaming_tpu.core.stream", "EdgeStream"),
     "SnapshotStream": ("gelly_streaming_tpu.core.snapshot", "SnapshotStream"),
+    "MeshAggregationRunner": (
+        "gelly_streaming_tpu.core.aggregation",
+        "MeshAggregationRunner",
+    ),
 }
 
 __all__ = list(_EXPORTS)
