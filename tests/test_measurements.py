@@ -1,0 +1,73 @@
+"""Measurement CLIs (the pom.xml ghost measurement jars, made real).
+
+Small CPU-sized runs asserting each subcommand's JSON contract and sanity of
+the reported values (degree conservation, known bipartite verdicts).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.examples import measurements
+
+
+def _run(argv, capsys):
+    measurements.main(argv)
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_degrees_conserves_edge_endpoints(capsys):
+    out = _run(
+        ["degrees", "--edges", "4096", "--vertices", "512", "--batch", "512"],
+        capsys,
+    )
+    assert out["workload"] == "degrees"
+    assert out["edges_per_sec"] > 0
+    assert out["edges_folded"] == 4096
+    # every folded edge contributes exactly two endpoint counts
+    assert out["degree_total"] == 2 * out["edges_folded"]
+
+
+def test_degrees_small_edges_shrink_batch(capsys):
+    """--edges below --batch must still meter (batch auto-shrinks to keep a
+    warmup batch plus at least one measured batch)."""
+    out = _run(
+        ["degrees", "--edges", "100", "--vertices", "64", "--batch", "512"],
+        capsys,
+    )
+    assert out["edges_per_sec"] > 0
+    assert out["edges_folded"] == 100
+    assert out["degree_total"] == 200
+
+
+def test_bipartiteness_random_dense_is_odd(capsys):
+    out = _run(
+        ["bipartiteness", "--edges", "4096", "--vertices", "64", "--batch", "512"],
+        capsys,
+    )
+    assert out["workload"] == "bipartiteness"
+    # a dense random graph on 64 vertices contains odd cycles w.h.p.
+    assert out["bipartite"] is False
+
+
+def test_triangles_reports_latency_percentiles(capsys):
+    out = _run(
+        [
+            "triangles",
+            "--edges", "2048",
+            "--windows", "2",
+            "--pane-vertices", "128",
+        ],
+        capsys,
+    )
+    assert out["workload"] == "triangles"
+    assert out["windows"] == 2
+    assert out["triangles_total"] > 0
+    assert out["p50_window_ms"] > 0
+    assert out["p95_window_ms"] >= out["p50_window_ms"]
+
+
+def test_requires_subcommand():
+    with pytest.raises(SystemExit):
+        measurements.main([])
