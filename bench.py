@@ -39,57 +39,34 @@ def main():
     # pipeline (larger batches exceed the tunnel's profitable transfer size)
     batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 18))
 
-    import jax
+    import jax.numpy as jnp
 
     from gelly_streaming_tpu.io import wire
     from gelly_streaming_tpu.ops import unionfind as uf
-    from gelly_streaming_tpu.utils.metrics import ThroughputMeter
+    from gelly_streaming_tpu.utils.ingest_bench import wire_stream_fold
     from gelly_streaming_tpu.utils.native import load_ingest_lib
 
     rng = np.random.default_rng(0)
     src = rng.integers(0, capacity, num_edges).astype(np.int32)
     dst = rng.integers(0, capacity, num_edges).astype(np.int32)
 
-    # ---- TPU streaming fold -------------------------------------------------
-    device = jax.devices()[0]
-    width = wire.width_for_capacity(capacity)
+    # ---- TPU streaming fold (shared wire-ingest harness) -------------------
+    def make_fold(batch, width):
+        def fold(state, wire_buf):
+            parent, seen = state
+            s, d = wire.unpack_edges(wire_buf, batch, width)
+            return uf.union_edges_with_seen(parent, seen, s, d, None)
 
-    def fold_wire(parent, seen, wire_buf):
-        s, d = wire.unpack_edges(wire_buf, batch, width)
-        return uf.union_edges_with_seen(parent, seen, s, d, None)
+        return fold
 
-    # Donate the summary state: the fold updates parent/seen in place on
-    # device instead of allocating fresh HBM buffers every micro-batch.
-    fold = jax.jit(fold_wire, donate_argnums=(0, 1))
-
-    import jax.numpy as jnp
-
-    parent = jax.device_put(uf.init_parent(capacity), device)
-    seen = jax.device_put(jnp.zeros((capacity,), bool), device)
-
-    # full batches only: the kernel shape is fixed, a trailing partial batch
-    # would need a differently-shaped unpack (and a recompile)
-    n_batches = num_edges // batch
-
-    # Warmup/compile on the first batch through the same wire path.
-    w0 = jax.device_put(wire.pack_edges(src[:batch], dst[:batch], width), device)
-    parent, seen = fold(parent, seen, w0)
-    jax.block_until_ready(parent)
-
-    def batches():
-        for i in range(1, n_batches):
-            yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
-
-    meter = ThroughputMeter()
-    meter.start()
-    for wire_buf, n in wire.WirePrefetcher(batches(), width, device, depth=8):
-        parent, seen = fold(parent, seen, wire_buf)
-        meter.record_batch(n)
-    jax.block_until_ready(parent)
-    meter.stop()
-    folded_edges = batch * n_batches  # incl. warmup batch
-
-    tpu_eps = meter.edges_per_sec
+    tpu_eps, folded_edges, (parent, seen) = wire_stream_fold(
+        src,
+        dst,
+        capacity,
+        batch,
+        make_fold,
+        lambda: (uf.init_parent(capacity), jnp.zeros((capacity,), bool)),
+    )
     labels_tpu = np.asarray(uf.compress(parent))
 
     # ---- native CPU baseline (same stream, sequential union-find) ----------

@@ -29,48 +29,15 @@ import numpy as np
 
 
 def _stream_fold(num_edges, capacity, batch, seed, make_fold, init_state):
-    """Shared harness: synthetic edge stream through wire ingest + jitted fold.
-
-    The first batch is unmetered compile warmup, so the batch size shrinks
-    when needed to keep at least two batches; only full batches fold (the
-    kernel shape is static).  Returns (edges_per_sec, edges_folded, state).
-    """
-    import jax
-
-    from gelly_streaming_tpu.io import wire
-    from gelly_streaming_tpu.utils.metrics import ThroughputMeter
+    """Synthetic edge stream through the shared wire-ingest harness."""
+    from gelly_streaming_tpu.utils.ingest_bench import wire_stream_fold
 
     if num_edges < 2:
         raise SystemExit("--edges must be at least 2")
-    batch = min(batch, num_edges // 2)
-
     rng = np.random.default_rng(seed)
     src = rng.integers(0, capacity, num_edges).astype(np.int32)
     dst = rng.integers(0, capacity, num_edges).astype(np.int32)
-    device = jax.devices()[0]
-    width = wire.width_for_capacity(capacity)
-
-    fold = jax.jit(make_fold(batch, width), donate_argnums=0)
-    state = jax.tree.map(lambda a: jax.device_put(a, device), init_state())
-
-    n_batches = num_edges // batch  # >= 2 by construction
-    w0 = jax.device_put(wire.pack_edges(src[:batch], dst[:batch], width), device)
-    state = fold(state, w0)
-    jax.block_until_ready(state)
-
-    def batches():
-        for i in range(1, n_batches):
-            yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
-
-    meter = ThroughputMeter()
-    meter.start()
-    with wire.WirePrefetcher(batches(), width, device, depth=8) as pf:
-        for buf, n in pf:
-            state = fold(state, buf)
-            meter.record_batch(n)
-    jax.block_until_ready(state)
-    meter.stop()
-    return meter.edges_per_sec, n_batches * batch, state
+    return wire_stream_fold(src, dst, capacity, batch, make_fold, init_state)
 
 
 def measure_degrees(args) -> dict:
@@ -156,6 +123,12 @@ def measure_triangles(args) -> dict:
     rec = WindowLatencyRecorder()
     k = args.pane_vertices
     per_pane = max(1, args.edges // max(1, args.windows))
+    # unmetered warmup pane: the first call compiles the kernel (hundreds of
+    # ms), which would otherwise dominate the latency percentiles
+    _pane_triangle_count(
+        rng.integers(0, k, per_pane).astype(np.int32),
+        rng.integers(0, k, per_pane).astype(np.int32),
+    )
     total = 0
     for _ in range(args.windows):
         src = rng.integers(0, k, per_pane).astype(np.int32)

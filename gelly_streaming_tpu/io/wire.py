@@ -33,24 +33,72 @@ import numpy as np
 from ..utils.native import load_ingest_lib
 
 
-def width_for_capacity(capacity: int) -> int:
-    """Narrowest supported byte width covering ids in [0, capacity)."""
+PAIR40 = "pair40"  # 5-byte (src, dst) pair packing for capacities <= 2^20
+
+
+def width_for_capacity(capacity: int):
+    """Tightest supported encoding covering ids in [0, capacity).
+
+    Returns a byte width (2/3/4, ids packed in separate src/dst blocks) or
+    ``PAIR40`` (each edge as one 5-byte 20+20-bit pair) — the narrowest wins:
+    capacities in (2^16, 2^20] get 5 bytes/edge instead of 6.
+    """
     if capacity <= 1 << 16:
-        return 2
+        return 2  # 4 bytes/edge
+    if capacity <= 1 << 20:
+        return PAIR40  # 5 bytes/edge
     if capacity <= 1 << 24:
-        return 3
+        return 3  # 6 bytes/edge
     return 4
 
 
-def pack_edges(src: np.ndarray, dst: np.ndarray, width: int) -> np.ndarray:
-    """Pack an edge batch into a uint8 wire buffer (src block then dst block)."""
-    if width not in (2, 3, 4):
+def _pack_edges40(src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    n = src.shape[0]
+    lib = load_ingest_lib()
+    if lib is not None and hasattr(lib, "pack_edges40"):
+        out = np.empty(5 * n, np.uint8)
+        wrote = lib.pack_edges40(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if wrote == out.nbytes:
+            return out
+    # numpy fallback: widen to u64 words, take the low 5 little-endian bytes
+    w = (src.astype(np.uint64) & 0xFFFFF) | (
+        (dst.astype(np.uint64) & 0xFFFFF) << np.uint64(20)
+    )
+    b = w.view(np.uint8).reshape(-1, 8)[:, :5]
+    return np.ascontiguousarray(b).reshape(-1)
+
+
+def _unpack_edges40(wire, n: int):
+    import jax.numpy as jnp
+
+    b = wire.reshape(n, 5).astype(jnp.uint32)
+    lo = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)  # bits 0..23
+    src = (lo & 0xFFFFF).astype(jnp.int32)
+    hi = (b[:, 2] >> 4) | (b[:, 3] << 4) | (b[:, 4] << 12)  # bits 20..39
+    dst = hi.astype(jnp.int32)
+    return src, dst
+
+
+def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
+    """Pack an edge batch into a uint8 wire buffer.
+
+    ``width`` is a byte width (2/3/4: src block then dst block, ids truncated
+    to little-endian bytes) or ``PAIR40`` (5-byte packed pairs).
+    """
+    if width not in (2, 3, 4, PAIR40):
         raise ValueError(f"unsupported wire width {width}")
     src = np.ascontiguousarray(src, dtype=np.int32)
     dst = np.ascontiguousarray(dst, dtype=np.int32)
     n = src.shape[0]
     if dst.shape[0] != n:
         raise ValueError("src/dst length mismatch")
+    if width == PAIR40:
+        return _pack_edges40(src, dst)
     lib = load_ingest_lib()
     if lib is not None and hasattr(lib, "pack_edges"):
         out = np.empty(2 * n * width, np.uint8)
@@ -71,14 +119,16 @@ def pack_edges(src: np.ndarray, dst: np.ndarray, width: int) -> np.ndarray:
     return np.concatenate([low_bytes(src), low_bytes(dst)])
 
 
-def unpack_edges(wire, n: int, width: int):
-    """Device-side unpack: uint8[2*n*width] -> (src, dst) int32[n].
+def unpack_edges(wire, n: int, width):
+    """Device-side unpack: wire uint8 buffer -> (src, dst) int32[n].
 
     Jit-friendly (static n/width); the byte combines fuse into the caller's
     surrounding kernel so the unpack adds no extra HBM round trip.
     """
     import jax.numpy as jnp
 
+    if width == PAIR40:
+        return _unpack_edges40(wire, n)
     b = wire.reshape(2, n, width).astype(jnp.uint32)
     v = b[..., 0]
     for k in range(1, width):

@@ -14,12 +14,33 @@ import subprocess
 import threading
 from typing import Optional
 
-_REPO_ROOT = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-_SRC = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_SO = os.path.join(_BUILD_DIR, "libgelly_ingest.so")
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def _find_src() -> str:
+    """The C++ source: repo layout (native/) or installed package data
+    (gelly_streaming_tpu/native_src/, shipped so pip installs keep the native
+    ingest path instead of silently falling back to numpy)."""
+    for cand in (
+        os.path.join(_REPO_ROOT, "native", "edge_parser.cpp"),
+        os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+
+
+_SRC = _find_src()
+# Prefer the repo-layout build dir; installed (possibly read-only) packages
+# fall back to a per-user cache.
+_BUILD_DIRS = [
+    os.path.join(_REPO_ROOT, "native", "build"),
+    os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "gelly_streaming_tpu",
+    ),
+]
 
 _lock = threading.Lock()
 _lib = None
@@ -31,16 +52,27 @@ def _build() -> Optional[str]:
         src_mtime = os.path.getmtime(_SRC)
     except OSError:
         # source not shipped: use a prebuilt .so if present, else fall back
-        return _SO if os.path.exists(_SO) else None
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
-        return _SO
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _SO
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+        for d in _BUILD_DIRS:
+            so = os.path.join(d, "libgelly_ingest.so")
+            if os.path.exists(so):
+                return so
         return None
+    for d in _BUILD_DIRS:
+        so = os.path.join(d, "libgelly_ingest.so")
+        if os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
+            return so
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o"]
+    for d in _BUILD_DIRS:
+        so = os.path.join(d, "libgelly_ingest.so")
+        try:
+            os.makedirs(d, exist_ok=True)
+            subprocess.run(
+                cmd + [so], check=True, capture_output=True, timeout=120
+            )
+            return so
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+            continue
+    return None
 
 
 def load_ingest_lib():
@@ -86,5 +118,13 @@ def load_ingest_lib():
                 ctypes.POINTER(ctypes.c_uint8),
             ]
             lib.pack_edges.restype = ctypes.c_int64
+        if hasattr(lib, "pack_edges40"):
+            lib.pack_edges40.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.pack_edges40.restype = ctypes.c_int64
         _lib = lib
         return _lib

@@ -199,6 +199,28 @@ int64_t pack_edges(const int32_t* src, const int32_t* dst, int64_t n,
   return q - out;
 }
 
+// Tightest wire format for vertex spaces up to 2^20: each (src, dst) pair is
+// packed into 5 bytes (20 bits per id, little-endian; dst occupies the high
+// nibble of byte 2 upward).  5 bytes/edge vs 6 for the 3-byte-per-id block
+// format — the host->device link is the bottleneck, so this is ~17% more
+// stream throughput when ids fit.
+int64_t pack_edges40(const int32_t* src, const int32_t* dst, int64_t n,
+                     uint8_t* out) {
+  uint8_t* q = out;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t s = static_cast<uint32_t>(src[i]) & 0xFFFFF;
+    uint32_t d = static_cast<uint32_t>(dst[i]) & 0xFFFFF;
+    uint64_t w = static_cast<uint64_t>(s) | (static_cast<uint64_t>(d) << 20);
+    q[0] = w & 0xFF;
+    q[1] = (w >> 8) & 0xFF;
+    q[2] = (w >> 16) & 0xFF;
+    q[3] = (w >> 24) & 0xFF;
+    q[4] = (w >> 32) & 0xFF;
+    q += 5;
+  }
+  return q - out;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

@@ -24,9 +24,33 @@ def _random_edges(n, capacity, seed=0):
 
 def test_width_for_capacity_boundaries():
     assert wire.width_for_capacity(1 << 16) == 2
-    assert wire.width_for_capacity((1 << 16) + 1) == 3
+    assert wire.width_for_capacity((1 << 16) + 1) == wire.PAIR40
+    assert wire.width_for_capacity(1 << 20) == wire.PAIR40
+    assert wire.width_for_capacity((1 << 20) + 1) == 3
     assert wire.width_for_capacity(1 << 24) == 3
     assert wire.width_for_capacity((1 << 24) + 1) == 4
+
+
+def test_pair40_roundtrip_and_size():
+    import jax.numpy as jnp
+
+    src, dst = _random_edges(513, 1 << 20, seed=11)
+    buf = wire.pack_edges(src, dst, wire.PAIR40)
+    assert buf.shape == (5 * 513,)  # 5 bytes per edge
+    s, d = wire.unpack_edges(jnp.asarray(buf), 513, wire.PAIR40)
+    np.testing.assert_array_equal(np.asarray(s), src)
+    np.testing.assert_array_equal(np.asarray(d), dst)
+
+
+def test_pair40_native_matches_numpy(monkeypatch):
+    lib = load_ingest_lib()
+    if lib is None or not hasattr(lib, "pack_edges40"):
+        pytest.skip("native pack_edges40 unavailable")
+    src, dst = _random_edges(1000, 1 << 20, seed=12)
+    native_buf = wire.pack_edges(src, dst, wire.PAIR40)
+    monkeypatch.setattr(wire, "load_ingest_lib", lambda: None)
+    fallback_buf = wire.pack_edges(src, dst, wire.PAIR40)
+    np.testing.assert_array_equal(native_buf, fallback_buf)
 
 
 @pytest.mark.parametrize("width", [2, 3, 4])
