@@ -62,6 +62,28 @@ def sage_kernel(params: SageParams, features, keys, nbrs, valid):
 sage_kernel_jit = jax.jit(sage_kernel)
 
 
+def sage_kernel_ring(params: SageParams, block, keys, nbrs, valid, num_shards):
+    """Sharded-feature GraphSAGE layer (call inside shard_map).
+
+    The feature matrix is modulo-sharded into per-device blocks; the ring
+    exchange (parallel/ring.py) streams every block past every shard so the
+    masked neighbor mean and self rows assemble without replicating X — the
+    framework's ring-attention-style schedule.  The projections stay local
+    bf16 MXU matmuls on each shard's [K, F] slice.
+    """
+    from gelly_streaming_tpu.parallel.ring import ring_neighbor_features
+
+    x_self, mean_nbr, _ = ring_neighbor_features(
+        block, keys, nbrs, valid, num_shards
+    )
+    h = (
+        x_self.astype(jnp.bfloat16) @ params.w_self
+        + mean_nbr.astype(jnp.bfloat16) @ params.w_nbr
+        + params.bias
+    )
+    return jax.nn.relu(h)
+
+
 class GraphSAGEWindows:
     """Per-window vertex embeddings over a sliced edge stream."""
 

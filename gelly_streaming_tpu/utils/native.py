@@ -18,29 +18,33 @@ _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REPO_ROOT = os.path.dirname(_PKG_ROOT)
 
 
-def _find_src() -> str:
+def _find_src():
     """The C++ source: repo layout (native/) or installed package data
     (gelly_streaming_tpu/native_src/, shipped so pip installs keep the native
-    ingest path instead of silently falling back to numpy)."""
-    for cand in (
-        os.path.join(_REPO_ROOT, "native", "edge_parser.cpp"),
-        os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp"),
-    ):
-        if os.path.exists(cand):
-            return cand
-    return os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+    ingest path instead of silently falling back to numpy).  Returns
+    (path, is_repo_layout)."""
+    repo_src = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+    if os.path.exists(repo_src):
+        return repo_src, True
+    pkg_src = os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp")
+    if os.path.exists(pkg_src):
+        return pkg_src, False
+    return repo_src, True
 
 
-_SRC = _find_src()
-# Prefer the repo-layout build dir; installed (possibly read-only) packages
-# fall back to a per-user cache.
-_BUILD_DIRS = [
-    os.path.join(_REPO_ROOT, "native", "build"),
-    os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
-        "gelly_streaming_tpu",
-    ),
-]
+_SRC, _IS_REPO_LAYOUT = _find_src()
+_CACHE_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+    "gelly_streaming_tpu",
+)
+# Repo checkouts build under native/build; installed packages go straight to
+# the per-user cache (building into site-packages would leave an unowned
+# directory behind on uninstall).
+_BUILD_DIRS = (
+    [os.path.join(_REPO_ROOT, "native", "build"), _CACHE_DIR]
+    if _IS_REPO_LAYOUT
+    else [_CACHE_DIR]
+)
 
 _lock = threading.Lock()
 _lib = None
