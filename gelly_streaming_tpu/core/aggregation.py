@@ -91,6 +91,20 @@ class SummaryAggregation:
             self._combine_cache = jax.jit(self.combine)
         return self._combine_cache
 
+    def _checkpoint_like(self, cfg):
+        """Checkpoint structure: summary + presence flag + stream position.
+
+        ``global_done`` marks the untimed single global pane as folded —
+        it has no orderable id (-1), so it needs its own done flag for
+        replay-safe skipping.
+        """
+        return {
+            "summary": self.initial_state(cfg),
+            "has_summary": np.zeros((), bool),
+            "last_window": np.full((), -1, np.int64),
+            "global_done": np.zeros((), bool),
+        }
+
     def run(
         self,
         stream,
@@ -100,16 +114,25 @@ class SummaryAggregation:
         """Execute over an EdgeStream (entered via GraphStream.aggregate,
         GraphStream.java:139-140 / SimpleEdgeStream.java:100-102).
 
-        With ``checkpoint_path``, the running summary is snapshot after every
-        window close and restored on start — the Merger's ListCheckpointed
-        behavior (SummaryAggregation.java:127-135), generalized to the whole
-        summary pytree (closing the reference's unsaved-state gap)."""
+        With ``checkpoint_path``, the running summary AND the stream position
+        (last closed window id) are snapshot after every window close and
+        restored on start — the Merger's ListCheckpointed behavior
+        (SummaryAggregation.java:127-135), generalized to the whole summary
+        pytree plus position (closing the reference's unsaved-state gap).  On
+        restore, panes already folded before the snapshot are skipped, so the
+        source may simply replay from the beginning.  State is exactly-once;
+        emissions after the last snapshot are re-emitted (at-least-once), as
+        in the reference's Merger.  The untimed single global pane resumes
+        only for an unchanged replay (it has no sub-pane position — a longer
+        replayed stream's extra untimed edges would be skipped with it)."""
         cfg = stream.cfg
         window_ms = self.window_ms or cfg.window_ms
         n_parts = self._num_partitions(cfg)
 
         def records() -> Iterator[tuple]:
             running = None
+            start_after = -1
+            global_done = False
             if checkpoint_path and restore:
                 from gelly_streaming_tpu.utils.checkpoint import (
                     checkpoint_exists,
@@ -117,8 +140,26 @@ class SummaryAggregation:
                 )
 
                 if checkpoint_exists(checkpoint_path):
-                    running = load_state(checkpoint_path, self.initial_state(cfg))
+                    try:
+                        snap = load_state(
+                            checkpoint_path, self._checkpoint_like(cfg)
+                        )
+                        if bool(snap["has_summary"]):
+                            running = snap["summary"]
+                        start_after = int(snap["last_window"])
+                        global_done = bool(snap["global_done"])
+                    except ValueError:
+                        # legacy snapshot layout: a bare summary pytree with
+                        # no stream position (pre-position checkpoints)
+                        running = load_state(
+                            checkpoint_path, self.initial_state(cfg)
+                        )
             for pane in assign_tumbling_windows(stream.batches(), window_ms):
+                already_folded = (0 <= pane.window_id <= start_after) or (
+                    pane.window_id == -1 and global_done
+                )
+                if already_folded:
+                    continue  # folded before the snapshot: replay-safe
                 partials = []
                 for part in range(n_parts):
                     # Round-robin partitioning of the pane stands in for the
@@ -160,11 +201,28 @@ class SummaryAggregation:
                 else:
                     running = self._combine_j(running, pane_summary)
                 out = self.transform(running)
+                # Emit BEFORE snapshotting: a crash between the two re-emits
+                # this window on recovery (at-least-once emission) instead of
+                # dropping it (at-most-once would lose sink data).
+                yield out if isinstance(out, tuple) else (out,)
+                start_after = max(pane.window_id, start_after)
+                global_done = global_done or pane.window_id == -1
                 if checkpoint_path:
                     from gelly_streaming_tpu.utils.checkpoint import save_state
 
-                    save_state(checkpoint_path, running)
-                yield out if isinstance(out, tuple) else (out,)
+                    # transient aggregations reset after emission, so a
+                    # restore must come back with no running summary
+                    save_state(
+                        checkpoint_path,
+                        {
+                            "summary": running,
+                            "has_summary": np.full(
+                                (), not self.transient_state, bool
+                            ),
+                            "last_window": np.full((), start_after, np.int64),
+                            "global_done": np.full((), global_done, bool),
+                        },
+                    )
                 if self.transient_state:
                     running = None
 

@@ -49,15 +49,23 @@ def load_state(path: str, like: Any) -> Any:
     with np.load(path) as data:
         leaves_like, treedef = jax.tree.flatten(like)
         n = len(leaves_like)
-        stored = [data[f"leaf_{i}"] for i in range(n)]
+        # validate the layout BEFORE touching leaves, so a structure change
+        # (e.g. a legacy snapshot) surfaces as ValueError, not KeyError
         token = json.loads(bytes(data["__treedef__"]).decode())
         if token != _treedef_token(like):
             raise ValueError(
                 f"checkpoint structure mismatch: stored {token}, "
                 f"expected {_treedef_token(like)}"
             )
+        stored = [data[f"leaf_{i}"] for i in range(n)]
+    # numpy leaves (host-side metadata like stream positions) restore as
+    # numpy — routing them through jnp would down-cast int64 under the
+    # default x64-disabled config; device arrays restore as device arrays.
     restored = [
-        jax.numpy.asarray(s, dtype=l.dtype) for s, l in zip(stored, leaves_like)
+        np.asarray(s, dtype=l.dtype)
+        if isinstance(l, np.ndarray)
+        else jax.numpy.asarray(s, dtype=l.dtype)
+        for s, l in zip(stored, leaves_like)
     ]
     return jax.tree.unflatten(treedef, restored)
 

@@ -1,0 +1,205 @@
+"""Failure recovery: crash mid-stream, restart from checkpoint, exact state.
+
+The supervisor (utils/recovery.py) rebuilds the pipeline after a failure; the
+aggregation checkpoint now carries the stream position, so the rebuilt run
+replays the source from the beginning and skips already-folded windows —
+summary state stays exactly-once even for non-idempotent folds (sums), which
+double-counting would corrupt.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.aggregation import SummaryBulkAggregation
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+from gelly_streaming_tpu.utils.recovery import run_supervised
+
+CFG = StreamConfig(vertex_capacity=16, max_degree=16)
+
+EDGES_T = [
+    (1, 2, 1.0, 10),
+    (3, 4, 2.0, 110),
+    (2, 3, 4.0, 210),
+    (5, 6, 8.0, 310),
+]
+
+
+class EdgeValueSum(SummaryBulkAggregation):
+    """Non-idempotent fold: re-folding any window inflates the sum."""
+
+    def initial_state(self, cfg):
+        return jnp.zeros((), jnp.float32)
+
+    def update(self, state, src, dst, val, mask):
+        return state + jnp.sum(jnp.where(mask, val, 0.0))
+
+    def combine(self, a, b):
+        return a + b
+
+    def transform(self, state):
+        return float(state)
+
+
+def _flaky_source(crash_on_attempt, crash_at_batch=None):
+    """Source factory: raises mid-stream on designated attempts, then replays
+    the FULL stream on later attempts (recovery's replay contract).
+
+    ``crash_on_attempt`` is a set (all crash at ``crash_at_batch``) or a dict
+    attempt-number -> crash batch.
+    """
+    attempts = {"n": 0}
+    plan = (
+        crash_on_attempt
+        if isinstance(crash_on_attempt, dict)
+        else {a: crash_at_batch for a in crash_on_attempt}
+    )
+
+    def make_stream():
+        attempts["n"] += 1
+        crash_at = plan.get(attempts["n"])
+
+        def factory():
+            for i, e in enumerate(EDGES_T):
+                if crash_at is not None and i == crash_at:
+                    raise IOError("source died")
+                yield EdgeStream.from_collection(
+                    [e], CFG, batch_size=1, with_time=True
+                ).batches().__next__()
+
+        return EdgeStream.from_batches(factory, CFG)
+
+    return make_stream, attempts
+
+
+@pytest.mark.parametrize("agg_cls", [EdgeValueSum, ConnectedComponents])
+def test_crash_and_recover_matches_uninterrupted(tmp_path, agg_cls):
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    make_source, attempts = _flaky_source({1}, crash_at_batch=3)
+
+    records = list(
+        run_supervised(
+            lambda: agg_cls(window_ms=100).run(
+                make_source(), checkpoint_path=ckpt
+            ),
+            max_restarts=2,
+        )
+    )
+    assert attempts["n"] == 2  # crashed once, recovered once
+
+    full = agg_cls(window_ms=100).run(
+        EdgeStream.from_collection(EDGES_T, CFG, batch_size=1, with_time=True)
+    )
+    expected_final = full.collect()[-1]
+    assert str(records[-1][0]) == str(expected_final[0])
+    if agg_cls is EdgeValueSum:
+        # exactly-once: any double-folded window would inflate the sum
+        assert records[-1][0] == 15.0
+
+
+def test_exhausted_restarts_propagate(tmp_path):
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    # crashes on every attempt at the FIRST batch: no progress, budget exhausts
+    make_source, attempts = _flaky_source({1, 2, 3, 4, 5}, crash_at_batch=0)
+    with pytest.raises(IOError, match="source died"):
+        list(
+            run_supervised(
+                lambda: EdgeValueSum(window_ms=100).run(
+                    make_source(), checkpoint_path=ckpt
+                ),
+                max_restarts=2,
+            )
+        )
+    assert attempts["n"] == 3  # initial + 2 restarts
+
+
+def test_progress_resets_restart_budget(tmp_path):
+    """Each crash at a later point is a fresh failure, not a wedged stream."""
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    # attempt 1 crashes at batch 2, attempt 2 later at batch 3 (after having
+    # emitted a new window), attempt 3 completes
+    make_source, attempts = _flaky_source({1: 2, 2: 3})
+    records = list(
+        run_supervised(
+            lambda: EdgeValueSum(window_ms=100).run(
+                make_source(), checkpoint_path=ckpt
+            ),
+            max_restarts=1,  # would exhaust without the progress reset
+        )
+    )
+    assert attempts["n"] == 3
+    assert records[-1][0] == 15.0
+
+
+def test_untimed_global_pane_does_not_double_fold(tmp_path):
+    """An unchanged replay of an untimed stream must not re-fold the single
+    global pane into the restored summary."""
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    untimed = [(1, 2, 1.0), (3, 4, 2.0)]
+
+    def run_once():
+        stream = EdgeStream.from_collection(untimed, CFG, batch_size=1)
+        return EdgeValueSum().run(stream, checkpoint_path=ckpt).collect()
+
+    first = run_once()
+    assert first[-1][0] == 3.0
+    second = run_once()  # full replay with the checkpoint present
+    # the global pane was already folded: nothing new to emit, and the
+    # summary must NOT become 6.0
+    assert second == []
+
+
+def test_legacy_bare_summary_checkpoint_still_restores(tmp_path):
+    """Pre-position checkpoints (bare summary pytree) keep their old
+    contract: restore the summary, caller feeds only the unprocessed tail."""
+    from gelly_streaming_tpu.utils.checkpoint import save_state
+
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    save_state(ckpt, jnp.asarray(7.0, jnp.float32))  # legacy layout
+    stream = EdgeStream.from_collection(
+        EDGES_T[2:], CFG, batch_size=1, with_time=True
+    )
+    out = EdgeValueSum(window_ms=100).run(stream, checkpoint_path=ckpt).collect()
+    assert out[-1][0] == 7.0 + 4.0 + 8.0
+
+
+def test_emission_precedes_snapshot(tmp_path):
+    """A crash right after a yield (before the snapshot that follows the
+    NEXT window) re-emits: windows are at-least-once, never dropped."""
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    make_source, attempts = _flaky_source({}, None)
+
+    seen = []
+    gen = iter(
+        EdgeValueSum(window_ms=100).run(make_source(), checkpoint_path=ckpt)
+    )
+    seen.append(next(gen))  # window 0 emitted...
+    del gen  # ...and the consumer dies before ever resuming the generator
+
+    # recovery replays: window 0 must appear again (its snapshot only lands
+    # when the generator resumes after the yield, which never happened)
+    out = EdgeValueSum(window_ms=100).run(
+        make_source(), checkpoint_path=ckpt
+    ).collect()
+    assert seen[0][0] == 1.0
+    assert [r[0] for r in out] == [1.0, 3.0, 7.0, 15.0]
+
+
+def test_on_restart_hook_observes_failures(tmp_path):
+    ckpt = os.path.join(str(tmp_path), "state.npz")
+    make_source, _ = _flaky_source({1}, crash_at_batch=2)
+    seen = []
+    list(
+        run_supervised(
+            lambda: EdgeValueSum(window_ms=100).run(
+                make_source(), checkpoint_path=ckpt
+            ),
+            max_restarts=2,
+            on_restart=lambda n, e: seen.append((n, str(e))),
+        )
+    )
+    assert seen == [(1, "source died")]
