@@ -19,7 +19,7 @@ Prints ONE JSON line:
    "vs_baseline": ...}
 
 Scale knobs via env: GELLY_BENCH_EDGES (default 16M), GELLY_BENCH_VERTICES
-(default 2^20), GELLY_BENCH_BATCH (default 2^18).
+(default 2^20), GELLY_BENCH_BATCH (default 2^20).
 """
 
 import ctypes
@@ -35,9 +35,10 @@ import numpy as np
 def main():
     num_edges = int(os.environ.get("GELLY_BENCH_EDGES", 1 << 24))
     capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
-    # 2^18 sits at the measured sweet spot of the host->device transfer
-    # pipeline (larger batches exceed the tunnel's profitable transfer size)
-    batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 18))
+    # 2^20 edges (5 MB on the 40-bit wire) sits at the measured sweet spot of
+    # the host->device transfer pipeline; both smaller (2^18) and larger
+    # (2^22) batches measure ~15% slower through the tunnel
+    batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 20))
 
     import jax.numpy as jnp
 

@@ -1,0 +1,50 @@
+"""gelly_streaming_tpu: a TPU-native framework for single-pass streaming graph analytics.
+
+A from-scratch JAX/XLA re-design of the capabilities of Gelly-Streaming
+(reference: /root/reference, Apache Flink's streaming-graph API).  A graph is an
+unbounded stream of edges; the framework never materializes the full graph — it
+maintains *summaries* as dense, sharded device arrays updated by batched SPMD
+kernels.  Hosts own time (sources, watermarks, windows, sinks); the TPU mesh owns
+the data plane (routing, segment reductions, collective combines).
+
+Package map (reference counterpart in parentheses):
+  core/      stream API, windows, aggregation runtime (GraphStream.java,
+             SimpleEdgeStream.java, SnapshotStream.java, SummaryAggregation.java)
+  ops/       batched device kernels: segment ops, union-find, neighbor tables
+             (replaces the per-record JVM hot loops, e.g. DisjointSet.java:66-118)
+  parallel/  mesh, edge routing, collective combines (replaces the Flink network
+             stack consumed via keyBy/broadcast/timeWindowAll, pom.xml:38-63)
+  summaries/ graph summaries as arrays (summaries/DisjointSet.java, Candidates.java,
+             AdjacencyListGraph.java)
+  library/   single-pass algorithms (library/*.java and example/*.java algorithms)
+  examples/  runnable CLI programs mirroring the reference example argv contracts
+  io/        sources/sinks, native-accelerated edge parsing
+  utils/     config, metrics, checkpointing, value types (util/*.java)
+"""
+
+__version__ = "0.1.0"
+
+# Lazy exports keep `import gelly_streaming_tpu.ops.x` cheap and cycle-free.
+_EXPORTS = {
+    "EdgeBatch": ("gelly_streaming_tpu.core.types", "EdgeBatch"),
+    "EventType": ("gelly_streaming_tpu.core.types", "EventType"),
+    "EdgeDirection": ("gelly_streaming_tpu.core.types", "EdgeDirection"),
+    "StreamConfig": ("gelly_streaming_tpu.core.config", "StreamConfig"),
+    "EdgeStream": ("gelly_streaming_tpu.core.stream", "EdgeStream"),
+    "SnapshotStream": ("gelly_streaming_tpu.core.snapshot", "SnapshotStream"),
+    "MeshAggregationRunner": (
+        "gelly_streaming_tpu.core.aggregation",
+        "MeshAggregationRunner",
+    ),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        module, attr = _EXPORTS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,55 @@
+"""Typed configuration for the streaming runtime.
+
+The reference has no config framework — every example hand-parses argv and
+library knobs are constructor params (SURVEY.md §5.6; e.g.
+example/ConnectedComponentsExample.java:81-102).  Here a single typed config
+carries the capacity/mesh/window knobs that static XLA shapes require.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Static-shape and distribution knobs for a stream pipeline.
+
+    Attributes:
+      vertex_capacity: dense vertex-id space size C.  Vertex ids are interned to
+        [0, C); all per-vertex state is a dense array of length C (the TPU answer
+        to the reference's unbounded per-key HashMaps,
+        SimpleEdgeStream.java:461-478).
+      max_degree: per-vertex neighbor-table capacity D for stateful adjacency
+        (distinct / buildNeighborhood analogs, SimpleEdgeStream.java:301-323,531-560).
+      batch_size: edges per micro-batch (padded; the unit of device dispatch).
+      num_shards: number of mesh shards the vertex space is partitioned over.
+      window_ms: default tumbling-window length in milliseconds (the reference's
+        per-aggregation mergeWindowTime, SummaryBulkAggregation.java:79).
+      tree_degree: fan-in of the tree combine (SummaryTreeReduce.java:53-64 analog).
+    """
+
+    vertex_capacity: int = 1 << 16
+    max_degree: int = 64
+    batch_size: int = 1 << 10
+    num_shards: int = 1
+    window_ms: int = 1000
+    tree_degree: int = 2
+
+    def __post_init__(self):
+        if self.vertex_capacity <= 0:
+            raise ValueError("vertex_capacity must be positive")
+        if self.num_shards <= 0:
+            raise ValueError("num_shards must be positive")
+        if self.vertex_capacity % self.num_shards != 0:
+            raise ValueError(
+                f"vertex_capacity ({self.vertex_capacity}) must be divisible by "
+                f"num_shards ({self.num_shards}) for even sharding"
+            )
+
+    @property
+    def shard_capacity(self) -> int:
+        return self.vertex_capacity // self.num_shards
+
+
+DEFAULT_CONFIG = StreamConfig()

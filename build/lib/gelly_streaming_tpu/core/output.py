@@ -1,0 +1,83 @@
+"""Record output streams and sinks.
+
+The reference's property streams are Flink ``DataStream``s written with
+``writeAsCsv`` or collected in test sinks (e.g. TestGetDegrees.java:54-56,
+ConnectedComponentsTest.java:84-94).  Here a terminal op yields per-batch record
+blocks (dict of equal-length host arrays + validity mask); ``OutputStream``
+wraps that iterator with collect/CSV sinks using the same rendering the golden
+files assert (Flink Tuple CSV: ``1,2,12``; NullValue -> ``(null)``; nested
+tuples -> ``(12,13)``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class NullValue:
+    """Singleton mirroring Flink's NullValue; renders as ``(null)`` in CSV."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "(null)"
+
+
+NULL = NullValue()
+
+
+def _render(x) -> str:
+    if isinstance(x, NullValue):
+        return "(null)"
+    if isinstance(x, tuple):
+        return "(" + ",".join(_render(v) for v in x) + ")"
+    if isinstance(x, (bool, np.bool_)):
+        return "true" if x else "false"
+    if isinstance(x, (float, np.floating)):
+        return repr(float(x))
+    if isinstance(x, (int, np.integer)):
+        return str(int(x))
+    return str(x)
+
+
+class OutputStream:
+    """A continuous stream of records produced by a terminal operation.
+
+    ``records_fn`` is a zero-arg callable returning an iterator of host tuples
+    (so the stream can be re-run, mirroring a dataflow's lazy execution).
+    """
+
+    def __init__(self, records_fn: Callable[[], Iterator[tuple]]):
+        self._records_fn = records_fn
+
+    def __iter__(self) -> Iterator[tuple]:
+        return self._records_fn()
+
+    def collect(self) -> List[tuple]:
+        return list(self._records_fn())
+
+    def collect_last(self) -> Optional[tuple]:
+        last = None
+        for r in self._records_fn():
+            last = r
+        return last
+
+    def lines(self) -> List[str]:
+        """CSV lines in the reference's writeAsCsv rendering."""
+        return [",".join(_render(f) for f in rec) for rec in self._records_fn()]
+
+    def write_csv(self, path: str) -> None:
+        with open(path, "w") as f:
+            for line in self.lines():
+                f.write(line + "\n")
+
+    def print(self) -> None:
+        for rec in self._records_fn():
+            print(",".join(_render(f) for f in rec))

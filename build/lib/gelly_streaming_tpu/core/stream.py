@@ -1,0 +1,516 @@
+"""EdgeStream: the graph-stream API (reference: GraphStream.java + SimpleEdgeStream.java).
+
+The reference models a graph as a Flink ``DataStream<Edge>`` with lazy
+transformations and per-key stateful operators.  Here an ``EdgeStream`` is a
+lazy pipeline of *stages* over padded COO micro-batches: each stage is a pure
+``(state, batch) -> (state, batch)`` function; the whole pipeline is composed
+and jitted once, and state (dense per-vertex arrays) threads functionally
+through the run — the SPMD replacement for Flink's keyed operator state.
+
+API parity map (reference file:line):
+  map_edges            SimpleEdgeStream.java:217   (value transform per edge)
+  filter_edges         SimpleEdgeStream.java:290
+  filter_vertices      SimpleEdgeStream.java:257-281 (predicate on both endpoints)
+  distinct             SimpleEdgeStream.java:301-323 (stateful seen-table)
+  reverse              SimpleEdgeStream.java:328
+  undirected           SimpleEdgeStream.java:350-361 (emit edge + reverse)
+  union                SimpleEdgeStream.java:343
+  get_vertices         SimpleEdgeStream.java:116-129 (first-occurrence emission)
+  get_degrees/in/out   SimpleEdgeStream.java:413-478 (running degree trace)
+  number_of_vertices   SimpleEdgeStream.java:366-383 (running distinct count)
+  number_of_edges      SimpleEdgeStream.java:388-404 (running edge count)
+  slice                SimpleEdgeStream.java:135-167 -> core/snapshot.py
+  aggregate            SimpleEdgeStream.java:100-102 -> core/aggregation.py
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.output import NULL, OutputStream
+from gelly_streaming_tpu.core.types import EdgeBatch, EdgeDirection
+from gelly_streaming_tpu.ops import neighbors, segments
+
+
+# ---------------------------------------------------------------------------
+# Pipeline stages
+# ---------------------------------------------------------------------------
+
+
+class Stage:
+    """A pure pipeline stage.  ``init`` builds the state pytree; ``apply`` is
+    jit-traced as part of the composed pipeline step."""
+
+    def init(self, cfg: StreamConfig):
+        return ()
+
+    def apply(self, state, batch: EdgeBatch):
+        raise NotImplementedError
+
+
+class _Stateless(Stage):
+    def __init__(self, fn: Callable[[EdgeBatch], EdgeBatch]):
+        self.fn = fn
+
+    def apply(self, state, batch):
+        return state, self.fn(batch)
+
+
+class _DistinctStage(Stage):
+    """Stateful distinct on (src, dst) endpoint pairs.
+
+    Mirrors DistinctEdgeMapper's per-key HashSet (SimpleEdgeStream.java:309-323)
+    with a device neighbor table.  Note: the reference's set is over the whole
+    Edge including its value; the array-native summary dedupes by endpoints —
+    a deliberate re-design (values ride along with the first occurrence).
+    """
+
+    def init(self, cfg):
+        return neighbors.init_table(cfg.vertex_capacity, cfg.max_degree)
+
+    def apply(self, state, batch):
+        table, is_new = neighbors.insert_unique_batch(
+            state, batch.src, batch.dst, batch.mask
+        )
+        return table, batch.replace(mask=is_new)
+
+
+# ---------------------------------------------------------------------------
+# EdgeStream
+# ---------------------------------------------------------------------------
+
+
+class EdgeStream:
+    """A (possibly infinite) stream of graph edges over a dense vertex space.
+
+    Construction:
+      EdgeStream.from_collection(edges, cfg)      finite host collection
+      EdgeStream.from_batches(factory, cfg)       any re-runnable batch source
+    """
+
+    def __init__(
+        self,
+        source_factory: Callable[[], Iterator[EdgeBatch]],
+        cfg: StreamConfig,
+        stages: Tuple[Stage, ...] = (),
+    ):
+        self._source_factory = source_factory
+        self.cfg = cfg
+        self._stages = stages
+
+    # ---- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_collection(
+        edges: Sequence[tuple],
+        cfg: StreamConfig = StreamConfig(),
+        batch_size: Optional[int] = None,
+        with_time: bool = False,
+    ) -> "EdgeStream":
+        """Finite in-memory stream (the tests' analog of env.fromCollection).
+
+        ``with_time`` reads a 4th tuple element as the event timestamp,
+        mirroring the event-time SimpleEdgeStream ctor
+        (SimpleEdgeStream.java:86-90); otherwise arrival order is time
+        (ingestion-time ctor, SimpleEdgeStream.java:69-73).
+        """
+        edges = list(edges)
+        bs = batch_size or (len(edges) if edges else 1)
+
+        def factory():
+            for i in range(0, max(len(edges), 1), bs):
+                chunk = edges[i : i + bs]
+                if not chunk:
+                    return
+                yield EdgeBatch.from_edges(chunk, pad_to=bs, with_time=with_time)
+
+        return EdgeStream(factory, cfg)
+
+    @staticmethod
+    def from_batches(
+        factory: Callable[[], Iterator[EdgeBatch]], cfg: StreamConfig = StreamConfig()
+    ) -> "EdgeStream":
+        return EdgeStream(factory, cfg)
+
+    def _with(self, stage: Stage) -> "EdgeStream":
+        return EdgeStream(self._source_factory, self.cfg, self._stages + (stage,))
+
+    # ---- transformations (lazy) --------------------------------------------
+
+    def map_edges(self, fn: Callable) -> "EdgeStream":
+        """Transform each edge's value: fn(src, dst, val) -> new val (pytree ok).
+
+        Reference: SimpleEdgeStream.java:217 (mapEdges maps the edge value;
+        tuple-typed results mirror TestMapEdges' Tuple2 goldens).
+        """
+
+        def tx(batch: EdgeBatch) -> EdgeBatch:
+            return batch.replace(val=fn(batch.src, batch.dst, batch.val))
+
+        return self._with(_Stateless(tx))
+
+    def filter_edges(self, pred: Callable) -> "EdgeStream":
+        """Keep edges where pred(src, dst, val) is True (SimpleEdgeStream.java:290)."""
+
+        def tx(batch: EdgeBatch) -> EdgeBatch:
+            keep = pred(batch.src, batch.dst, batch.val)
+            return batch.replace(mask=batch.mask & keep)
+
+        return self._with(_Stateless(tx))
+
+    def filter_vertices(self, pred: Callable) -> "EdgeStream":
+        """Keep edges whose BOTH endpoints satisfy pred(vertex_ids)
+        (reference applies the vertex filter to source and target,
+        SimpleEdgeStream.java:264-281)."""
+
+        def tx(batch: EdgeBatch) -> EdgeBatch:
+            keep = pred(batch.src) & pred(batch.dst)
+            return batch.replace(mask=batch.mask & keep)
+
+        return self._with(_Stateless(tx))
+
+    def reverse(self) -> "EdgeStream":
+        """Swap src/dst (SimpleEdgeStream.java:328)."""
+        return self._with(_Stateless(lambda b: b.reversed()))
+
+    def undirected(self) -> "EdgeStream":
+        """Emit each edge in both directions (SimpleEdgeStream.java:350-361).
+        Doubles the static batch size."""
+        return self._with(_Stateless(lambda b: b.concat(b.reversed())))
+
+    def distinct(self) -> "EdgeStream":
+        """Drop edges whose endpoint pair was seen before (SimpleEdgeStream.java:301-323)."""
+        return self._with(_DistinctStage())
+
+    def union(self, other: "EdgeStream") -> "EdgeStream":
+        """Merge two edge streams (SimpleEdgeStream.java:343).  Batches from
+        both (fully transformed) streams interleave round-robin."""
+        if other.cfg.vertex_capacity != self.cfg.vertex_capacity:
+            raise ValueError("union requires matching vertex_capacity")
+        left, right = self, other
+
+        def factory():
+            its = [left.batches(), right.batches()]
+            for batch in _round_robin(its):
+                yield batch
+
+        return EdgeStream(factory, self.cfg)
+
+    # ---- execution ----------------------------------------------------------
+
+    def _compiled_step(self):
+        stages = self._stages
+
+        def step(states, batch):
+            out_states = []
+            for stage, st in zip(stages, states):
+                st, batch = stage.apply(st, batch)
+                out_states.append(st)
+            return tuple(out_states), batch
+
+        return jax.jit(step)
+
+    def batches(self) -> Iterator[EdgeBatch]:
+        """Run the pipeline, yielding transformed micro-batches."""
+        states = tuple(stage.init(self.cfg) for stage in self._stages)
+        step = self._compiled_step()
+        for batch in self._source_factory():
+            states, out = step(states, batch)
+            yield out
+
+    def collect_edges(self) -> List[tuple]:
+        out: List[tuple] = []
+        for b in self.batches():
+            out.extend(b.to_tuples())
+        return out
+
+    def edges_csv_lines(self) -> List[str]:
+        return OutputStream(lambda: iter(self.collect_edges())).lines()
+
+    # ---- continuous property streams ---------------------------------------
+
+    def get_vertices(self) -> OutputStream:
+        """(vertex, NullValue) on each vertex's first appearance
+        (SimpleEdgeStream.java:116-129: EmitSrcAndTarget + FilterDistinctVertices)."""
+        cfg = self.cfg
+
+        def kernel(seen, batch):
+            v, m = _interleave_endpoints(batch)
+            new = segments.first_occurrence_mask(v, m) & ~seen[v] & m
+            seen = seen.at[jnp.where(m, v, 0)].max(m)
+            return seen, v, new
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            seen = jnp.zeros((cfg.vertex_capacity,), bool)
+            for batch in self.batches():
+                seen, v, new = kernel(seen, batch)
+                for vertex in np.asarray(v)[np.asarray(new)]:
+                    yield (int(vertex), NULL)
+
+        return OutputStream(records)
+
+    def get_degrees(self) -> OutputStream:
+        """Running (vertex, degree) trace over both endpoints
+        (SimpleEdgeStream.java:413-415, DegreeTypeSeparator both flags true)."""
+        return self._degree_stream(EdgeDirection.ALL)
+
+    def get_in_degrees(self) -> OutputStream:
+        return self._degree_stream(EdgeDirection.IN)
+
+    def get_out_degrees(self) -> OutputStream:
+        return self._degree_stream(EdgeDirection.OUT)
+
+    def _degree_stream(self, direction: EdgeDirection) -> OutputStream:
+        """The continuous degree property stream.
+
+        Batched trace-exact form of DegreeMapFunction's per-record HashMap
+        update (SimpleEdgeStream.java:461-478): the k-th in-batch occurrence of
+        vertex v emits ``base[v] + k + 1`` and a segment add bumps the base.
+        """
+        cfg = self.cfg
+
+        def kernel(counts, batch):
+            if direction == EdgeDirection.ALL:
+                v, m = _interleave_endpoints(batch)
+            elif direction == EdgeDirection.OUT:
+                v, m = batch.src, batch.mask
+            else:
+                v, m = batch.dst, batch.mask
+            rank = segments.occurrence_rank(v, m)
+            emitted = counts[v] + rank + 1
+            counts = counts.at[jnp.where(m, v, 0)].add(m.astype(jnp.int32))
+            return counts, v, emitted, m
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            counts = jnp.zeros((cfg.vertex_capacity,), jnp.int32)
+            for batch in self.batches():
+                counts, v, emitted, m = kernel(counts, batch)
+                v_h, e_h, m_h = np.asarray(v), np.asarray(emitted), np.asarray(m)
+                for i in np.nonzero(m_h)[0]:
+                    yield (int(v_h[i]), int(e_h[i]))
+
+        return OutputStream(records)
+
+    def number_of_vertices(self) -> OutputStream:
+        """Running distinct-vertex count, emitted on change
+        (SimpleEdgeStream.java:366-383 via globalAggregate's change-dedup
+        GlobalAggregateMapper :562-576)."""
+        cfg = self.cfg
+
+        def kernel(seen, batch):
+            v, m = _interleave_endpoints(batch)
+            new = segments.first_occurrence_mask(v, m) & ~seen[v] & m
+            base = jnp.sum(seen.astype(jnp.int32))
+            running = base + jnp.cumsum(new.astype(jnp.int32))
+            seen = seen.at[jnp.where(m, v, 0)].max(m)
+            return seen, running, new
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            seen = jnp.zeros((cfg.vertex_capacity,), bool)
+            for batch in self.batches():
+                seen, running, new = kernel(seen, batch)
+                r_h = np.asarray(running)
+                for i in np.nonzero(np.asarray(new))[0]:
+                    yield (int(r_h[i]),)
+
+        return OutputStream(records)
+
+    def number_of_edges(self) -> OutputStream:
+        """Running edge count, one record per arriving edge
+        (parallelism-1 counter, SimpleEdgeStream.java:388-404)."""
+
+        def kernel(total, batch):
+            running = total + jnp.cumsum(batch.mask.astype(jnp.int32))
+            return total + batch.num_valid(), running
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            total = jnp.zeros((), jnp.int32)
+            for batch in self.batches():
+                total, running = kernel(total, batch)
+                r_h = np.asarray(running)
+                for i in np.nonzero(np.asarray(batch.mask))[0]:
+                    yield (int(r_h[i]),)
+
+        return OutputStream(records)
+
+    def get_edges(self) -> OutputStream:
+        """The edge stream itself as records (GraphStream.getEdges)."""
+
+        def records():
+            for batch in self.batches():
+                for t in batch.to_tuples():
+                    yield t
+
+        return OutputStream(records)
+
+    def keyed_aggregate(
+        self,
+        edge_expand: Callable,
+        state_init: Callable,
+        vertex_update: Callable,
+    ) -> OutputStream:
+        """Generic keyed aggregation — the reference's
+        ``aggregate(edgeMapper, vertexMapper)`` (SimpleEdgeStream.java:489-494:
+        flatMap -> keyBy(0) -> stateful map), array-form:
+
+          edge_expand(src, dst, val) -> (keys [M, B], vals pytree of [M, B])
+              vectorized flatMap emitting M records per edge (static M);
+          state_init(cfg) -> dense per-key state pytree (arrays over [0, C));
+          vertex_update(state, keys [N], vals [N], mask [N])
+              -> (state, out pytree of [N], out_mask [N])
+              batched keyed update; use ops.segments.occurrence_rank for
+              running per-key semantics within a batch.
+
+        Returns the (key, out...) record stream.
+        """
+        cfg = self.cfg
+
+        def kernel(state, batch):
+            keys, vals = edge_expand(batch.src, batch.dst, batch.val)
+            m = keys.shape[0]
+            flat_keys = keys.reshape(-1)
+            flat_vals = jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), vals)
+            flat_mask = jnp.tile(batch.mask, (m, 1)).reshape(-1)
+            state, out, out_mask = vertex_update(
+                state, flat_keys, flat_vals, flat_mask
+            )
+            return state, flat_keys, out, out_mask
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            state = state_init(cfg)
+            for batch in self.batches():
+                state, keys, out, out_mask = kernel(state, batch)
+                k_h = np.asarray(keys)
+                m_h = np.asarray(out_mask)
+                leaves = [np.asarray(x) for x in jax.tree.leaves(out)]
+                treedef = jax.tree.structure(out)
+                for i in np.nonzero(m_h)[0]:
+                    rec = jax.tree.unflatten(
+                        treedef, [leaf[i].item() for leaf in leaves]
+                    )
+                    if isinstance(rec, tuple):
+                        yield (int(k_h[i]),) + rec
+                    else:
+                        yield (int(k_h[i]), rec)
+
+        return OutputStream(records)
+
+    def global_aggregate(
+        self,
+        update: Callable,
+        initial_state: Callable,
+        result: Callable,
+        emit_on_change: bool = True,
+    ) -> OutputStream:
+        """Centralized (parallelism-1 analog) aggregation with change-dedup
+        (SimpleEdgeStream.java:505-519 + GlobalAggregateMapper :562-576).
+
+        update(state, batch) -> state (jitted once); result(state) -> host
+        value; a record is emitted per batch only when the result changes
+        (always, when emit_on_change=False).
+        """
+        cfg = self.cfg
+        update_j = jax.jit(update)
+
+        def records():
+            state = initial_state(cfg)
+            prev = None
+            for batch in self.batches():
+                state = update_j(state, batch)
+                res = result(state)
+                if not emit_on_change or res != prev:
+                    yield res if isinstance(res, tuple) else (res,)
+                    prev = res
+
+        return OutputStream(records)
+
+    def build_neighborhood(self, directed: bool = False) -> OutputStream:
+        """Continuous adjacency stream (SimpleEdgeStream.java:531-560): emits
+        (src, dst, sorted-neighbors-of-src) per arriving edge, with adjacency
+        state as of the end of the edge's micro-batch (the reference's per-key
+        TreeSet trace is recovered exactly at batch_size=1).
+
+        directed=False mirrors the reference default: the stream is made
+        undirected first, so each edge contributes both directions.
+        """
+        cfg = self.cfg
+        base = self if directed else self.undirected()
+
+        def kernel(table, batch):
+            table, _ = neighbors.insert_unique_batch(
+                table, batch.src, batch.dst, batch.mask
+            )
+            rows, valid = neighbors.gather_rows(table, batch.src)
+            return table, rows, valid
+
+        kernel = jax.jit(kernel)
+
+        def records():
+            table = neighbors.init_table(cfg.vertex_capacity, cfg.max_degree)
+            for batch in base.batches():
+                table, rows, valid = kernel(table, batch)
+                s_h = np.asarray(batch.src)
+                d_h = np.asarray(batch.dst)
+                m_h = np.asarray(batch.mask)
+                r_h = np.asarray(rows)
+                v_h = np.asarray(valid)
+                for i in np.nonzero(m_h)[0]:
+                    nbrs = tuple(sorted(int(x) for x in r_h[i][v_h[i]]))
+                    yield (int(s_h[i]), int(d_h[i]), nbrs)
+
+        return OutputStream(records)
+
+    # ---- windows & aggregations (defined in sibling modules) ----------------
+
+    def slice(self, window_ms: Optional[int] = None, direction: EdgeDirection = EdgeDirection.OUT):
+        """Tumbling-window snapshot stream (SimpleEdgeStream.java:135-167)."""
+        from gelly_streaming_tpu.core.snapshot import SnapshotStream
+
+        return SnapshotStream(self, window_ms or self.cfg.window_ms, direction)
+
+    def aggregate(self, summary_aggregation) -> OutputStream:
+        """Run a summary aggregation over this stream
+        (GraphStream.java:139-140 -> SummaryAggregation.run)."""
+        return summary_aggregation.run(self)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _interleave_endpoints(batch: EdgeBatch) -> Tuple[jax.Array, jax.Array]:
+    """Per-edge (src, dst) emission order, flattened to [2B]
+    (mirrors EmitSrcAndTarget / DegreeTypeSeparator emission order,
+    SimpleEdgeStream.java:181-188,450-458)."""
+    v = jnp.stack([batch.src, batch.dst], axis=1).reshape(-1)
+    m = jnp.stack([batch.mask, batch.mask], axis=1).reshape(-1)
+    return v, m
+
+
+def _round_robin(iterators: List[Iterator]) -> Iterator:
+    iterators = list(iterators)
+    while iterators:
+        nxt = []
+        for it in iterators:
+            try:
+                yield next(it)
+                nxt.append(it)
+            except StopIteration:
+                pass
+        iterators = nxt

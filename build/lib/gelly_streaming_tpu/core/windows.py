@@ -1,0 +1,155 @@
+"""Host-side window discretization: the time plane of the framework.
+
+The reference delegates windowing to Flink (``timeWindow`` over event/ingestion
+time, SimpleEdgeStream.java:135-167; every aggregation is windowed,
+SummaryBulkAggregation.java:79-81).  In the TPU design the *host owns time*
+(SURVEY.md §7): sources attach timestamps, this module assigns edges to tumbling
+panes and flushes a pane when the (ascending) watermark passes its end — the
+device only ever sees fixed-shape pane micro-batches.
+
+Timestamps are assumed ascending, mirroring the reference's event-time ctor
+with an ``AscendingTimestampExtractor`` (SimpleEdgeStream.java:86-90).  Streams
+without timestamps form a single global pane flushed at end-of-stream (the
+finite-test analog of "one ingestion-time window", e.g. TestSlice's 1s window
+over a 7-edge collection).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, NamedTuple, Optional
+
+import numpy as np
+
+from gelly_streaming_tpu.core.types import EdgeBatch
+
+
+class WindowPane(NamedTuple):
+    """A closed tumbling window's edges, materialized as host arrays."""
+
+    window_id: int
+    max_timestamp: int  # inclusive window end (end_ms - 1); -1 for global pane
+    src: np.ndarray
+    dst: np.ndarray
+    val: Optional[object]  # np array or pytree of np arrays, aligned with src
+    time: Optional[np.ndarray]
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.src.shape[0])
+
+
+def _batch_to_host(batch: EdgeBatch):
+    mask = np.asarray(batch.mask)
+    idx = np.nonzero(mask)[0]
+    src = np.asarray(batch.src)[idx]
+    dst = np.asarray(batch.dst)[idx]
+    val = None
+    if batch.val is not None:
+        import jax
+
+        val = jax.tree.map(lambda a: np.asarray(a)[idx], batch.val)
+    time = None if batch.time is None else np.asarray(batch.time)[idx]
+    return src, dst, val, time
+
+
+class PaneAssembler:
+    """Accumulates per-window edge parts and assembles closed panes.
+
+    Shared by the single-host assigner below and the multi-host gated
+    assigners (parallel/multihost.py) so pane assembly semantics cannot
+    diverge between the paths.
+    """
+
+    def __init__(self, window_ms: int):
+        self.window_ms = window_ms
+        self._open = {}  # window_id -> list of (src, dst, val, time)
+        # remembered stream structure so empty shares stay shape-compatible
+        self._val_proto = None  # pytree of zero-length arrays, or None
+        self._has_time = False
+
+    def _remember_structure(self, val, time) -> None:
+        if val is not None and self._val_proto is None:
+            import jax
+
+            self._val_proto = jax.tree.map(lambda a: a[:0], val)
+        self._has_time = self._has_time or time is not None
+
+    def add(self, src, dst, val, time, wids) -> None:
+        import jax
+
+        self._remember_structure(val, time)
+        for wid in np.unique(wids):
+            sel = wids == wid
+            self._open.setdefault(int(wid), []).append(
+                (
+                    src[sel],
+                    dst[sel],
+                    None if val is None else jax.tree.map(lambda a: a[sel], val),
+                    None if time is None else time[sel],
+                )
+            )
+
+    def add_untimed(self, src, dst, val) -> None:
+        """Single global pane (ingestion-time finite stream)."""
+        self._remember_structure(val, None)
+        self._open.setdefault(-1, []).append((src, dst, val, None))
+
+    def open_ids(self):
+        return sorted(self._open)
+
+    def close(self, wid: int) -> WindowPane:
+        """Assemble pane ``wid``; an id with no edges yields an empty share
+        whose val/time carry the stream's structure (zero-length arrays), so
+        cross-host positional pairing of shares never mixes None with pytrees.
+        """
+        max_ts = (wid + 1) * self.window_ms - 1 if wid >= 0 else -1
+        parts = self._open.pop(wid, None)
+        if parts is None:
+            empty = np.empty((0,), np.int32)
+            return WindowPane(
+                wid,
+                max_ts,
+                empty,
+                empty.copy(),
+                self._val_proto,
+                np.empty((0,), np.int64) if self._has_time else None,
+            )
+        src = np.concatenate([p[0] for p in parts])
+        dst = np.concatenate([p[1] for p in parts])
+        val = None
+        if parts[0][2] is not None:
+            import jax
+
+            val = jax.tree.map(
+                lambda *leaves: np.concatenate(leaves), *[p[2] for p in parts]
+            )
+        time = (
+            None if parts[0][3] is None else np.concatenate([p[3] for p in parts])
+        )
+        return WindowPane(wid, max_ts, src, dst, val, time)
+
+
+def assign_tumbling_windows(
+    batches: Iterator[EdgeBatch], window_ms: int
+) -> Iterator[WindowPane]:
+    """Group an (ascending-time) batch stream into closed tumbling panes."""
+    panes = PaneAssembler(window_ms)
+    watermark_id = -1
+
+    for batch in batches:
+        src, dst, val, time = _batch_to_host(batch)
+        if len(src) == 0:
+            continue
+        if time is None:
+            panes.add_untimed(src, dst, val)
+            continue
+        wids = time // window_ms
+        panes.add(src, dst, val, time, wids)
+        new_watermark = int(wids.max())
+        if new_watermark > watermark_id:
+            for wid in [w for w in panes.open_ids() if 0 <= w < new_watermark]:
+                yield panes.close(wid)
+            watermark_id = new_watermark
+
+    for wid in panes.open_ids():
+        yield panes.close(wid)

@@ -1,0 +1,25 @@
+"""Bipartiteness check example
+(reference: example/BipartitenessCheckExample.java:38-124, window 500ms).
+
+Usage: bipartiteness_check [input-path [output-path [window-ms]]]
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.bipartiteness import BipartitenessCheck
+
+USAGE = "bipartiteness_check [input-path [output-path [window-ms]]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 3)
+    window_ms = int(args[2]) if len(args) > 2 else 500
+    stream, output = input_stream(args)
+    emit(stream.aggregate(BipartitenessCheck(window_ms)), output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Broadcast sampling triangle-count estimate example
+(reference: example/BroadcastTriangleCount.java:38-270).
+
+Usage: broadcast_triangle_count [input-path [output-path [samples]]]
+Emits the running triangle-count estimate after each micro-batch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.sampled_triangles import BroadcastTriangleCount
+
+USAGE = "broadcast_triangle_count [input-path [output-path [samples]]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 3)
+    samples = int(args[2]) if len(args) > 2 else 1000
+    stream, output = input_stream(args)
+    emit(BroadcastTriangleCount(num_samplers=samples).run(stream), output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Fully-dynamic degree distribution example
+(reference: example/DegreeDistribution.java:43-193).
+
+Usage: degree_distribution [input-path [output-path]]
+Input lines are ``src dst +`` / ``src dst -`` (edge additions/deletions);
+emits continuous (degree, count) histogram updates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import DEFAULT_CFG, emit, parse_argv
+from gelly_streaming_tpu.io.sources import file_stream, generated_stream
+from gelly_streaming_tpu.library.degree_distribution import DegreeDistribution
+
+USAGE = "degree_distribution [input-path [output-path]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 2)
+    if args:
+        stream, _ = file_stream(args[0], DEFAULT_CFG, batch_size=64)
+    else:
+        stream = generated_stream(DEFAULT_CFG, 1000, num_vertices=100)
+    output = args[1] if len(args) > 1 else None
+    emit(DegreeDistribution().run(stream), output)
+
+
+if __name__ == "__main__":
+    main()

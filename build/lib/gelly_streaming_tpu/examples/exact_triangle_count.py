@@ -1,0 +1,25 @@
+"""Insertion-only exact triangle count example
+(reference: example/ExactTriangleCount.java:40-207).
+
+Usage: exact_triangle_count [input-path [output-path]]
+Emits continuous (vertexId, localCount) updates; key -1 carries the global count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.triangles import ExactTriangleCount
+
+USAGE = "exact_triangle_count [input-path [output-path]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 2)
+    stream, output = input_stream(args)
+    emit(ExactTriangleCount().run(stream), output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Incidence-sampling triangle-count estimate example
+(reference: example/IncidenceSamplingTriangleCount.java:37-336; seeded RNG
+0xDEADBEEF, :61).
+
+Usage: incidence_sampling_triangle_count [input-path [output-path [samples]]]
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.sampled_triangles import (
+    IncidenceSamplingTriangleCount,
+)
+
+USAGE = "incidence_sampling_triangle_count [input-path [output-path [samples]]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 3)
+    samples = int(args[2]) if len(args) > 2 else 1000
+    stream, output = input_stream(args)
+    emit(IncidenceSamplingTriangleCount(num_samplers=samples).run(stream), output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Iterative (label-propagation) connected components example
+(reference: example/IterativeConnectedComponents.java:45-229; the streaming
+feedback loop is replaced by the on-device fixed point).
+
+Usage: iterative_connected_components [input-path [output-path]]
+Emits a continuous (vertex, componentId) stream.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
+
+USAGE = "iterative_connected_components [input-path [output-path]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 2)
+    stream, output = input_stream(args)
+    emit(IterativeConnectedComponents().run(stream), output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""Measurement programs: degree / bipartiteness / triangle throughput+latency.
+
+The reference's pom.xml declares three measurement jars —
+``example.degrees.DegreeMeasurement``, ``example.bipartiteness.
+BipartiteMeasurement``, ``example.triangles.TriangleMeasurements``
+(pom.xml:144-188) — whose classes do not exist in its source tree (an
+out-of-tree benchmarking branch, SURVEY.md §6).  This module supplies working
+equivalents: each subcommand drives the framework's real ingest path (wire
+pack -> prefetched transfer -> jitted fold, as in bench.py) for one workload
+and prints ONE JSON line of metrics.
+
+  python -m gelly_streaming_tpu.examples.measurements degrees       [options]
+  python -m gelly_streaming_tpu.examples.measurements bipartiteness [options]
+  python -m gelly_streaming_tpu.examples.measurements triangles    [options]
+
+Options: --edges N --vertices C --batch B --seed S; triangles also takes
+--windows W --pane-vertices K (panes are K-vertex random graphs counted with
+the MXU kernel; reports p50/p95 per-window latency).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def _stream_fold(num_edges, capacity, batch, seed, make_fold, init_state):
+    """Synthetic edge stream through the shared wire-ingest harness."""
+    from gelly_streaming_tpu.utils.ingest_bench import wire_stream_fold
+
+    if num_edges < 2:
+        raise SystemExit("--edges must be at least 2")
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, capacity, num_edges).astype(np.int32)
+    dst = rng.integers(0, capacity, num_edges).astype(np.int32)
+    return wire_stream_fold(src, dst, capacity, batch, make_fold, init_state)
+
+
+def measure_degrees(args) -> dict:
+    """Continuous degree stream fold (getDegrees hot path,
+    SimpleEdgeStream.java:461-478 as a dense segment add)."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.io import wire
+    from gelly_streaming_tpu.ops import segments
+
+    def make_fold(batch, width):
+        def fold(counts, buf):
+            s, d = wire.unpack_edges(buf, batch, width)
+            v = jnp.concatenate([s, d])
+            return counts + segments.segment_sum(
+                jnp.ones_like(v), v, counts.shape[0], None
+            )
+
+        return fold
+
+    eps, folded, counts = _stream_fold(
+        args.edges,
+        args.vertices,
+        args.batch,
+        args.seed,
+        make_fold,
+        lambda: jnp.zeros((args.vertices,), jnp.int32),
+    )
+    total = int(np.asarray(counts).sum())
+    return {
+        "workload": "degrees",
+        "edges_per_sec": round(eps, 1),
+        "edges_folded": folded,
+        "degree_total": total,
+    }
+
+
+def measure_bipartiteness(args) -> dict:
+    """Streaming 2-coloring fold (BipartitenessCheck hot path as the
+    doubled-vertex parity union-find, ops/unionfind.py)."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.io import wire
+    from gelly_streaming_tpu.ops import unionfind as uf
+
+    def make_fold(batch, width):
+        def fold(state, buf):
+            parent2, seen = state
+            s, d = wire.unpack_edges(buf, batch, width)
+            parent2 = uf.parity_union_edges(parent2, s, d, None)
+            seen = seen.at[s].max(True).at[d].max(True)
+            return parent2, seen
+
+        return fold
+
+    eps, folded, (parent2, seen) = _stream_fold(
+        args.edges,
+        args.vertices,
+        args.batch,
+        args.seed,
+        make_fold,
+        lambda: (
+            uf.init_parity_parent(args.vertices),
+            jnp.zeros((args.vertices,), bool),
+        ),
+    )
+    ok = bool(uf.is_bipartite(parent2, seen))
+    return {
+        "workload": "bipartiteness",
+        "edges_per_sec": round(eps, 1),
+        "edges_folded": folded,
+        "bipartite": ok,
+    }
+
+
+def measure_triangles(args) -> dict:
+    """Per-window exact triangle count latency (WindowTriangles hot path via
+    the Pallas MXU kernel, ops/pallas_triangles.py)."""
+    from gelly_streaming_tpu.library.triangles import _pane_triangle_count
+    from gelly_streaming_tpu.utils.metrics import WindowLatencyRecorder
+
+    rng = np.random.default_rng(args.seed)
+    rec = WindowLatencyRecorder()
+    k = args.pane_vertices
+    per_pane = max(1, args.edges // max(1, args.windows))
+    # unmetered warmup pane: the first call compiles the kernel (hundreds of
+    # ms), which would otherwise dominate the latency percentiles
+    _pane_triangle_count(
+        rng.integers(0, k, per_pane).astype(np.int32),
+        rng.integers(0, k, per_pane).astype(np.int32),
+    )
+    total = 0
+    for _ in range(args.windows):
+        src = rng.integers(0, k, per_pane).astype(np.int32)
+        dst = rng.integers(0, k, per_pane).astype(np.int32)
+        rec.window_closed()
+        total += _pane_triangle_count(src, dst)
+        rec.result_emitted()
+    return {
+        "workload": "triangles",
+        "windows": args.windows,
+        "edges_per_window": per_pane,
+        "pane_vertices": k,
+        "triangles_total": int(total),
+        "p50_window_ms": round(rec.percentile(50), 2),
+        "p95_window_ms": round(rec.percentile(95), 2),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="measurements", description=__doc__)
+    sub = p.add_subparsers(dest="workload", required=True)
+    for name in ("degrees", "bipartiteness"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--edges", type=int, default=1 << 20)
+        sp.add_argument("--vertices", type=int, default=1 << 17)
+        sp.add_argument("--batch", type=int, default=1 << 16)
+        sp.add_argument("--seed", type=int, default=0)
+    sp = sub.add_parser("triangles")
+    sp.add_argument("--edges", type=int, default=1 << 17)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--windows", type=int, default=8)
+    sp.add_argument("--pane-vertices", type=int, default=1024)
+    args = p.parse_args(argv)
+    fn = {
+        "degrees": measure_degrees,
+        "bipartiteness": measure_bipartiteness,
+        "triangles": measure_triangles,
+    }[args.workload]
+    print(json.dumps(fn(args)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

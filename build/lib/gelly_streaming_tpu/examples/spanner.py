@@ -1,0 +1,35 @@
+"""k-Spanner example (reference: example/SpannerExample.java:40-165).
+
+Usage: spanner [input-path [output-path [window-ms [k]]]]
+Emits the spanner's edge set per merge window (flatten-and-print analog,
+SpannerExample.java:61-67).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.spanner import Spanner
+
+USAGE = "spanner [input-path [output-path [window-ms [k]]]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_argv(argv, USAGE, 4)
+    window_ms = int(args[2]) if len(args) > 2 else 1000
+    k = int(args[3]) if len(args) > 3 else 3
+    stream, output = input_stream(args)
+    results = stream.aggregate(Spanner(window_ms, k))
+
+    def records():
+        for (g,) in results:
+            for u, v in sorted(g.edges()):
+                yield (u, v)
+
+    emit(OutputStream(records), output)
+
+
+if __name__ == "__main__":
+    main()

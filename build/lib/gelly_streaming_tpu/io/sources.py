@@ -1,0 +1,169 @@
+"""Edge sources: files (native-accelerated), collections, and generators.
+
+The host ingest plane (SURVEY.md §5.8): parse, intern, timestamp, and batch
+edges into fixed-shape ``EdgeBatch``es for the device.  File parsing uses the
+C++ parser (native/edge_parser.cpp via ctypes) when a compiler is available
+and falls back to numpy text parsing otherwise — same arrays either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.core.types import EdgeBatch
+from gelly_streaming_tpu.io.interning import IdentityInterner, VertexInterner
+from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+
+def parse_edge_file(path: str):
+    """Parse an edge-list file into host arrays.
+
+    Returns (src i64, dst i64, val f64 | None, time i64 | None, sign i32 | None).
+    Format per line: ``src dst [value|+|-] [timestamp]`` with space/tab/comma
+    separators and #/% comments.
+    """
+    lib = load_ingest_lib()
+    if lib is not None:
+        n = lib.count_rows(path.encode())
+        if n < 0:
+            raise FileNotFoundError(path)
+        src = np.empty(n, np.int64)
+        dst = np.empty(n, np.int64)
+        val = np.empty(n, np.float64)
+        tim = np.empty(n, np.int64)
+        sign = np.empty(n, np.int32)
+        ncols = ctypes.c_int32(0)
+        rows = lib.fill_edges(
+            path.encode(),
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            val.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            tim.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sign.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            ctypes.byref(ncols),
+        )
+        if rows < 0:
+            raise IOError(f"failed to parse {path}")
+        nc = ncols.value
+        has_sign = bool(nc & 0x100)
+        nc &= 0xFF
+        src, dst = src[:rows], dst[:rows]
+        return (
+            src,
+            dst,
+            val[:rows] if (nc >= 3 and not has_sign) else None,
+            tim[:rows] if nc >= 4 else None,
+            sign[:rows] if has_sign else None,
+        )
+    return _parse_edge_file_numpy(path)
+
+
+def _parse_edge_file_numpy(path: str):
+    """Pure-python fallback parser (same contract as the native path)."""
+    src, dst, val, tim, sign = [], [], [], [], []
+    any_val = any_time = any_sign = False
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line[0] in "#%":
+                continue
+            parts = line.replace(",", " ").replace("\t", " ").split()
+            if len(parts) < 2:
+                continue
+            src.append(int(parts[0]))
+            dst.append(int(parts[1]))
+            v, t, sg = 0.0, 0, 1
+            if len(parts) > 2:
+                if parts[2] in ("+", "-"):
+                    sg = -1 if parts[2] == "-" else 1
+                    any_sign = True
+                else:
+                    v = float(parts[2])
+                    any_val = True
+            if len(parts) > 3:
+                t = int(float(parts[3]))
+                any_time = True
+            val.append(v)
+            tim.append(t)
+            sign.append(sg)
+    return (
+        np.array(src, np.int64),
+        np.array(dst, np.int64),
+        np.array(val, np.float64) if (any_val and not any_sign) else None,
+        np.array(tim, np.int64) if any_time else None,
+        np.array(sign, np.int32) if any_sign else None,
+    )
+
+
+def _batched(
+    src, dst, val, tim, sign, batch_size: int
+) -> Callable[[], Iterator[EdgeBatch]]:
+    def factory():
+        for i in range(0, len(src), batch_size):
+            j = min(i + batch_size, len(src))
+            yield EdgeBatch.from_arrays(
+                src[i:j],
+                dst[i:j],
+                val=None if val is None else val[i:j],
+                time=None if tim is None else tim[i:j],
+                sign=None if sign is None else sign[i:j],
+                pad_to=batch_size,
+            )
+
+    return factory
+
+
+def file_stream(
+    path: str,
+    cfg: StreamConfig,
+    interner: Optional[VertexInterner] = None,
+    batch_size: Optional[int] = None,
+) -> Tuple[EdgeStream, object]:
+    """EdgeStream over an edge-list file; returns (stream, interner).
+
+    With no interner given, ids are checked-identity (dense ints) unless any id
+    falls outside [0, capacity), in which case a VertexInterner is built.
+    """
+    src, dst, val, tim, sign = parse_edge_file(path)
+    if interner is None:
+        if len(src) and (
+            min(src.min(), dst.min()) < 0
+            or max(src.max(), dst.max()) >= cfg.vertex_capacity
+        ):
+            interner = VertexInterner(cfg.vertex_capacity)
+        else:
+            interner = IdentityInterner(cfg.vertex_capacity)
+    src_i = interner.intern_ints(src)
+    dst_i = interner.intern_ints(dst)
+    bs = batch_size or cfg.batch_size
+    # Timestamps ride through unchanged: tumbling windows are phase-aligned to
+    # absolute time (t // window), so shifting would move window boundaries.
+    # Device time is int32 ms — streams using epoch-ms should rebase at the
+    # source to a recent origin that is a multiple of the window length.
+    stream = EdgeStream.from_batches(
+        _batched(src_i, dst_i, val, tim, sign, bs), cfg
+    )
+    return stream, interner
+
+
+def generated_stream(
+    cfg: StreamConfig,
+    num_edges: int,
+    num_vertices: Optional[int] = None,
+    seed: int = 0,
+    batch_size: Optional[int] = None,
+) -> EdgeStream:
+    """Uniform random edge stream (the examples' generated-input fallback,
+    e.g. ConnectedComponentsExample.java:122-140)."""
+    n_v = num_vertices or cfg.vertex_capacity
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_v, num_edges).astype(np.int32)
+    dst = rng.integers(0, n_v, num_edges).astype(np.int32)
+    bs = batch_size or cfg.batch_size
+    return EdgeStream.from_batches(_batched(src, dst, None, None, None, bs), cfg)

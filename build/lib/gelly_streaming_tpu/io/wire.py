@@ -1,0 +1,223 @@
+"""Compact host->device wire format + prefetching transfer pipeline.
+
+The reference's data plane rides Flink's Netty shuffle; records cross process
+boundaries in serialized tuple form and the network is the throughput ceiling.
+In the TPU framework the analogous boundary is the host->device link, and the
+ingest side must (a) minimise bytes per edge and (b) keep transfers in flight
+while the device computes.  This module supplies both:
+
+* **Wire format** — an edge micro-batch is packed as the src block then the
+  dst block, each vertex id truncated to the narrowest little-endian byte
+  width (2/3/4) that covers the stream's vertex capacity.  A 24-bit width
+  (vertex spaces up to 16M) cuts transfer volume 25% vs raw int32 pairs; a
+  16-bit width (up to 64K vertices) halves it.  Packing is done by the native
+  library (native/edge_parser.cpp pack_edges) with a pure-numpy fallback;
+  unpacking runs on device inside the consumer's jitted step, where the byte
+  shuffles fuse into the surrounding kernel.
+
+* **WirePrefetcher** — a background thread that packs and ``device_put``s a
+  bounded number of batches ahead of the consumer, overlapping host packing
+  and link transfer with device compute (the Flink analog: source operators
+  run concurrently with downstream tasks, buffering on the network stack).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils.native import load_ingest_lib
+
+
+PAIR40 = "pair40"  # 5-byte (src, dst) pair packing for capacities <= 2^20
+
+
+def width_for_capacity(capacity: int):
+    """Tightest supported encoding covering ids in [0, capacity).
+
+    Returns a byte width (2/3/4, ids packed in separate src/dst blocks) or
+    ``PAIR40`` (each edge as one 5-byte 20+20-bit pair) — the narrowest wins:
+    capacities in (2^16, 2^20] get 5 bytes/edge instead of 6.
+    """
+    if capacity <= 1 << 16:
+        return 2  # 4 bytes/edge
+    if capacity <= 1 << 20:
+        return PAIR40  # 5 bytes/edge
+    if capacity <= 1 << 24:
+        return 3  # 6 bytes/edge
+    return 4
+
+
+def _pack_edges40(src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    n = src.shape[0]
+    lib = load_ingest_lib()
+    if lib is not None and hasattr(lib, "pack_edges40"):
+        out = np.empty(5 * n, np.uint8)
+        wrote = lib.pack_edges40(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if wrote == out.nbytes:
+            return out
+    # numpy fallback: widen to u64 words, take the low 5 little-endian bytes
+    w = (src.astype(np.uint64) & 0xFFFFF) | (
+        (dst.astype(np.uint64) & 0xFFFFF) << np.uint64(20)
+    )
+    b = w.view(np.uint8).reshape(-1, 8)[:, :5]
+    return np.ascontiguousarray(b).reshape(-1)
+
+
+def _unpack_edges40(wire, n: int):
+    import jax.numpy as jnp
+
+    b = wire.reshape(n, 5).astype(jnp.uint32)
+    lo = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)  # bits 0..23
+    src = (lo & 0xFFFFF).astype(jnp.int32)
+    hi = (b[:, 2] >> 4) | (b[:, 3] << 4) | (b[:, 4] << 12)  # bits 20..39
+    dst = hi.astype(jnp.int32)
+    return src, dst
+
+
+def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
+    """Pack an edge batch into a uint8 wire buffer.
+
+    ``width`` is a byte width (2/3/4: src block then dst block, ids truncated
+    to little-endian bytes) or ``PAIR40`` (5-byte packed pairs).
+    """
+    if width not in (2, 3, 4, PAIR40):
+        raise ValueError(f"unsupported wire width {width}")
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    n = src.shape[0]
+    if dst.shape[0] != n:
+        raise ValueError("src/dst length mismatch")
+    if width == PAIR40:
+        return _pack_edges40(src, dst)
+    lib = load_ingest_lib()
+    if lib is not None and hasattr(lib, "pack_edges"):
+        out = np.empty(2 * n * width, np.uint8)
+        wrote = lib.pack_edges(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            width,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if wrote == out.nbytes:
+            return out
+    # numpy fallback: little-endian int32 bytes, keep the low `width` of each 4
+    def low_bytes(x: np.ndarray) -> np.ndarray:
+        b = x.view(np.uint8).reshape(-1, 4)[:, :width]
+        return np.ascontiguousarray(b).reshape(-1)
+
+    return np.concatenate([low_bytes(src), low_bytes(dst)])
+
+
+def unpack_edges(wire, n: int, width):
+    """Device-side unpack: wire uint8 buffer -> (src, dst) int32[n].
+
+    Jit-friendly (static n/width); the byte combines fuse into the caller's
+    surrounding kernel so the unpack adds no extra HBM round trip.
+    """
+    import jax.numpy as jnp
+
+    if width == PAIR40:
+        return _unpack_edges40(wire, n)
+    b = wire.reshape(2, n, width).astype(jnp.uint32)
+    v = b[..., 0]
+    for k in range(1, width):
+        v = v | (b[..., k] << (8 * k))
+    v = v.astype(jnp.int32)
+    return v[0], v[1]
+
+
+class WirePrefetcher:
+    """Pack + transfer edge batches ahead of the device consumer.
+
+    Wraps an iterator of (src, dst) numpy batches; yields device-resident
+    uint8 wire buffers in order, keeping up to ``depth`` transfers in flight
+    on a background thread.  ``close()`` (or use as a context manager)
+    releases the producer thread and any in-flight buffers if the consumer
+    stops early; exhausting the iterator closes implicitly.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(
+        self,
+        batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+        width: int,
+        device=None,
+        depth: int = 4,
+    ):
+        import jax
+
+        self._width = width
+        self._device = device if device is not None else jax.devices()[0]
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(batches),), daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer has closed."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it: Iterator[Tuple[np.ndarray, np.ndarray]]):
+        import jax
+
+        try:
+            for src, dst in it:
+                if self._stop.is_set():
+                    return
+                wire = pack_edges(src, dst, self._width)
+                # device_put is async: the DMA overlaps the consumer's compute
+                if not self._put((jax.device_put(wire, self._device), src.shape[0])):
+                    return
+        except BaseException as e:  # surfaced on the consumer thread
+            self._error = e
+        finally:
+            self._put(self._SENTINEL)
+
+    def close(self):
+        """Stop the producer and drop queued buffers (idempotent)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._SENTINEL:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                yield item
+        finally:
+            self.close()

@@ -1,0 +1,34 @@
+"""Single-pass streaming graph algorithms (reference library/ + example/ algorithms)."""
+
+from gelly_streaming_tpu.library.bipartiteness import BipartitenessCheck
+from gelly_streaming_tpu.library.connected_components import (
+    ConnectedComponents,
+    ConnectedComponentsTree,
+    sharded_cc_fixpoint,
+    sharded_cc_round,
+)
+from gelly_streaming_tpu.library.degree_distribution import DegreeDistribution
+from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
+from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
+from gelly_streaming_tpu.library.sampled_triangles import (
+    BroadcastTriangleCount,
+    IncidenceSamplingTriangleCount,
+)
+from gelly_streaming_tpu.library.spanner import Spanner
+from gelly_streaming_tpu.library.triangles import ExactTriangleCount, window_triangles
+
+__all__ = [
+    "BipartitenessCheck",
+    "ConnectedComponents",
+    "ConnectedComponentsTree",
+    "sharded_cc_fixpoint",
+    "sharded_cc_round",
+    "DegreeDistribution",
+    "IterativeConnectedComponents",
+    "CentralizedWeightedMatching",
+    "BroadcastTriangleCount",
+    "IncidenceSamplingTriangleCount",
+    "Spanner",
+    "ExactTriangleCount",
+    "window_triangles",
+]

@@ -1,0 +1,94 @@
+"""1-layer GraphSAGE-style message passing over sliced windows.
+
+Not present in the reference (BASELINE.json lists it as a new TPU workload:
+"1-layer GraphSAGE message-passing as applyOnNeighbors over sliced windows").
+It exercises the framework's MXU path: per closed window, each keyed vertex
+aggregates its neighbors' feature vectors (masked mean over the padded [K, D]
+neighborhood tensor) and projects through two dense bfloat16 matmuls:
+
+    h_v = relu(x_v @ W_self + mean_{u in N(v)}(x_u) @ W_nbr + b)
+
+Feature gathers and the [K, D, F] -> [K, F] mean are VPU work; the projections
+are MXU matmuls — large, batched, bfloat16, exactly what the systolic array
+wants (SURVEY.md design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.core.snapshot import SnapshotStream
+from gelly_streaming_tpu.core.types import EdgeDirection
+
+
+class SageParams(NamedTuple):
+    w_self: jax.Array  # [F_in, F_out] bf16
+    w_nbr: jax.Array  # [F_in, F_out] bf16
+    bias: jax.Array  # [F_out] bf16
+
+
+def init_params(
+    key: jax.Array, in_features: int, out_features: int
+) -> SageParams:
+    k1, k2 = jax.random.split(key)
+    scale = 1.0 / np.sqrt(in_features)
+    return SageParams(
+        w_self=(jax.random.normal(k1, (in_features, out_features)) * scale).astype(
+            jnp.bfloat16
+        ),
+        w_nbr=(jax.random.normal(k2, (in_features, out_features)) * scale).astype(
+            jnp.bfloat16
+        ),
+        bias=jnp.zeros((out_features,), jnp.bfloat16),
+    )
+
+
+def sage_kernel(params: SageParams, features, keys, nbrs, valid):
+    """[K] keys + [K, D] padded neighborhoods -> [K, F_out] embeddings."""
+    x_self = features[keys].astype(jnp.bfloat16)  # [K, F]
+    x_nbr = features[nbrs].astype(jnp.bfloat16)  # [K, D, F]
+    w = valid.astype(jnp.bfloat16)[:, :, None]
+    denom = jnp.maximum(jnp.sum(w, axis=1), 1.0)
+    mean_nbr = jnp.sum(x_nbr * w, axis=1) / denom  # [K, F]
+    h = x_self @ params.w_self + mean_nbr @ params.w_nbr + params.bias
+    return jax.nn.relu(h)
+
+
+sage_kernel_jit = jax.jit(sage_kernel)
+
+
+class GraphSAGEWindows:
+    """Per-window vertex embeddings over a sliced edge stream."""
+
+    def __init__(self, params: SageParams, features):
+        self.params = params
+        self.features = jnp.asarray(features)
+
+    def run(self, snapshot: SnapshotStream) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yields (keys [K], embeddings [K, F_out]) per closed window."""
+        for hood in snapshot._neighborhood_panes():
+            emb = sage_kernel_jit(
+                self.params,
+                self.features,
+                jnp.asarray(hood.keys),
+                jnp.asarray(hood.nbrs),
+                jnp.asarray(hood.valid),
+            )
+            n = hood.num_keys
+            yield hood.keys[:n], np.asarray(emb.astype(jnp.float32))[:n]
+
+    def output(self, snapshot: SnapshotStream) -> OutputStream:
+        """(vertex, embedding-norm) records — a compact observable stream."""
+
+        def records():
+            for keys, emb in self.run(snapshot):
+                norms = np.linalg.norm(emb, axis=1)
+                for k, n in zip(keys, norms):
+                    yield (int(k), float(n))
+
+        return OutputStream(records)

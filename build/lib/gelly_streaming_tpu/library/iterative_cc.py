@@ -1,0 +1,60 @@
+"""Iterative connected components: on-device label propagation.
+
+Reference: example/IterativeConnectedComponents.java:45-167 — a Flink streaming
+*feedback iteration*: emitted (vertex, component) records re-enter the keyed
+flatMap (``edges.iterate()``/``closeWith`` :56-58), whose per-record state is a
+linear-scanned ``HashMap<compId, HashSet<vertex>>`` (:79-114).
+
+The feedback edge exists because a JVM dataflow can only propagate labels by
+sending records around the loop.  On a TPU the loop collapses into the batched
+union-find fixed point (``lax.while_loop`` + scatter-min — ops/unionfind.py),
+run per micro-batch against persistent labels: strictly less communication and
+the same converged labels (min component id).  This module emits the reference's
+observable output — a continuous (vertex, componentId) stream re-emitting
+affected vertices as merges happen.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.ops import unionfind as uf
+
+
+class IterativeConnectedComponents:
+    """Continuous (vertex, component) stream with on-device label propagation."""
+
+    def __init__(self):
+        def kernel(parent, seen, src, dst, mask):
+            parent, seen = uf.union_edges_with_seen(parent, seen, src, dst, mask)
+            return parent, seen
+
+        self._kernel = jax.jit(kernel)
+
+    def run(self, stream) -> OutputStream:
+        cfg = stream.cfg
+
+        def records():
+            parent = uf.init_parent(cfg.vertex_capacity)
+            seen = jnp.zeros((cfg.vertex_capacity,), bool)
+            prev = np.asarray(parent).copy()
+            prev_seen = np.zeros((cfg.vertex_capacity,), bool)
+            for batch in stream.batches():
+                parent, seen = self._kernel(
+                    parent, seen, batch.src, batch.dst, batch.mask
+                )
+                p_h, s_h = np.asarray(parent), np.asarray(seen)
+                # Re-emit every vertex whose label or membership changed — the
+                # observable effect of the reference's feedback re-emissions
+                # (IterativeConnectedComponents.java:116-167).
+                changed = (s_h & ~prev_seen) | (s_h & (p_h != prev))
+                for v in np.nonzero(changed)[0]:
+                    yield (int(v), int(p_h[v]))
+                prev, prev_seen = p_h, s_h
+            self.final_labels = np.asarray(parent)
+
+        return OutputStream(records)

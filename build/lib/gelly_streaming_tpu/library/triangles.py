@@ -1,0 +1,215 @@
+"""Triangle counting: windowed exact and insertion-only streaming exact.
+
+Window variant — reference example/WindowTriangles.java:50-65: slice(ALL) ->
+per-vertex candidate wedges (O(d^2), :82-115) -> keyBy(candidate edge) window
+join against real edges (:118-139) -> all-window sum.  The TPU-native
+re-design skips the wedge materialization entirely: per closed pane it builds
+the deduped undirected CSR and counts, for every canonical edge (u, v), the
+common neighbors |N(u) & N(v)| with one [E, D, D] masked equality reduction —
+each triangle is counted once per its three edges, so count = sum / 3.  Same
+result, no candidate shuffle.
+
+Streaming variant — reference example/ExactTriangleCount.java:43-56
+(KDD'16-style single pass): buildNeighborhood + canonical edges + stateful
+neighborhood intersection emitting per-vertex and global counter updates
+(:74-134).  Here the state is the device NeighborTable plus dense counter
+arrays; each edge's intersection is a masked row comparison, applied in batch
+arrival order via lax.scan (intersections must see the adjacency as of the
+edge's arrival).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.core.types import EdgeDirection
+from gelly_streaming_tpu.core.windows import assign_tumbling_windows
+from gelly_streaming_tpu.ops import neighbors as nbr_ops
+from gelly_streaming_tpu.ops import pallas_triangles
+
+
+# ---------------------------------------------------------------------------
+# Windowed exact count
+# ---------------------------------------------------------------------------
+
+
+# Panes whose compacted vertex count fits this bound use the dense MXU kernel
+# (ops/pallas_triangles.py): 16x faster than the CSR equality reduction at
+# K=4096 on a v5e chip, and the dense [K, K] bf16 adjacency stays modest
+# (<=128 MB).  Larger panes fall back to the padded-CSR path.  Off-TPU the
+# kernel runs in the Pallas interpreter (slow), so the dense path is kept only
+# small enough to stay test-friendly.
+DENSE_PANE_MAX_VERTICES = 8192
+DENSE_PANE_MAX_VERTICES_INTERPRET = 512
+
+
+def _dense_pane_bound() -> int:
+    return (
+        DENSE_PANE_MAX_VERTICES
+        if jax.default_backend() == "tpu"
+        else DENSE_PANE_MAX_VERTICES_INTERPRET
+    )
+
+
+def _pane_triangle_count(src: np.ndarray, dst: np.ndarray) -> int:
+    """Exact triangles among a pane's edges (host orchestration, device count)."""
+    lo = np.minimum(src, dst)
+    hi = np.maximum(src, dst)
+    keep = lo != hi
+    pairs = np.unique(np.stack([lo[keep], hi[keep]], axis=1), axis=0)
+    if len(pairs) == 0:
+        return 0
+    u, v = pairs[:, 0].astype(np.int32), pairs[:, 1].astype(np.int32)
+    verts, inv = np.unique(np.concatenate([u, v]), return_inverse=True)
+    cu, cv = inv[: len(u)].astype(np.int32), inv[len(u) :].astype(np.int32)
+    k_n = len(verts)
+    if k_n <= _dense_pane_bound():
+        return pallas_triangles.pane_triangles_dense(cu, cv, k_n)
+    deg = np.bincount(np.concatenate([cu, cv]), minlength=k_n)
+    d_max = int(deg.max())
+    return int(_count_kernel(jnp.asarray(cu), jnp.asarray(cv), k_n, d_max))
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _count_kernel(u: jax.Array, v: jax.Array, num_vertices: int, max_deg: int):
+    """sum over edges |N(u) & N(v)| / 3 with a padded-CSR equality reduction."""
+    e = u.shape[0]
+    table = nbr_ops.init_table(num_vertices, max_deg)
+    both_src = jnp.concatenate([u, v])
+    both_dst = jnp.concatenate([v, u])
+    table = nbr_ops.insert_batch(
+        table, both_src, both_dst, jnp.ones((2 * e,), bool)
+    )
+    rows_u, valid_u = nbr_ops.gather_rows(table, u)  # [E, D]
+    rows_v, valid_v = nbr_ops.gather_rows(table, v)
+    eq = (
+        (rows_u[:, :, None] == rows_v[:, None, :])
+        & valid_u[:, :, None]
+        & valid_v[:, None, :]
+    )
+    return jnp.sum(eq.astype(jnp.int32)) // 3
+
+
+def window_triangles(stream, window_ms: int) -> OutputStream:
+    """(triangle_count, window_max_timestamp) per closed pane
+    (output shape of WindowTriangles.java:60-65's final sum)."""
+
+    def records() -> Iterator[tuple]:
+        for pane in assign_tumbling_windows(stream.batches(), window_ms):
+            count = _pane_triangle_count(pane.src, pane.dst)
+            yield (count, pane.max_timestamp)
+
+    return OutputStream(records)
+
+
+# ---------------------------------------------------------------------------
+# Streaming exact count (insertion-only)
+# ---------------------------------------------------------------------------
+
+GLOBAL_KEY = -1  # reference routes the global counter under key -1
+# (ExactTriangleCount.java:108-110)
+
+
+class TriangleCountState(NamedTuple):
+    table: nbr_ops.NeighborTable  # undirected adjacency over the whole stream
+    local: jax.Array  # int32[C] per-vertex triangle counts
+    global_count: jax.Array  # int32[]
+
+
+def init_triangle_state(cfg: StreamConfig) -> TriangleCountState:
+    return TriangleCountState(
+        table=nbr_ops.init_table(cfg.vertex_capacity, cfg.max_degree),
+        local=jnp.zeros((cfg.vertex_capacity,), jnp.int32),
+        global_count=jnp.zeros((), jnp.int32),
+    )
+
+
+def triangle_update(
+    state: TriangleCountState, src, dst, mask
+) -> Tuple[TriangleCountState, jax.Array, jax.Array]:
+    """Fold an edge batch; returns (state, local_after[B,2], global_after[B]).
+
+    Per edge (in arrival order): count common neighbors c of the canonical
+    endpoints in the adjacency-so-far, bump local[u], local[v] by c, local[w]
+    by 1 for each common w, and the global count by c — then insert the edge
+    (IntersectNeighborhoods + SumAndEmitCounters semantics,
+    ExactTriangleCount.java:74-134, with duplicate edges ignored).
+    """
+    capacity, max_degree = state.table.nbrs.shape
+
+    def step(carry, inp):
+        table, local, glob = carry
+        u, v, ok = inp
+        lo = jnp.minimum(u, v)
+        hi = jnp.maximum(u, v)
+        dup = nbr_ops.contains_batch(table, lo[None], hi[None])[0] | (lo == hi)
+        ok = ok & ~dup
+        row_u = table.nbrs[lo]
+        row_v = table.nbrs[hi]
+        valid_u = jnp.arange(max_degree) < table.deg[lo]
+        valid_v = jnp.arange(max_degree) < table.deg[hi]
+        eq = (
+            (row_u[:, None] == row_v[None, :])
+            & valid_u[:, None]
+            & valid_v[None, :]
+        )
+        c = jnp.where(ok, jnp.sum(eq.astype(jnp.int32)), 0)
+        common_mask = jnp.any(eq, axis=1) & ok  # [D] over row_u slots
+        local = local.at[jnp.where(common_mask, row_u, 0)].add(
+            common_mask.astype(jnp.int32)
+        )
+        local = local.at[lo].add(c)
+        local = local.at[hi].add(c)
+        glob = glob + c
+        table = nbr_ops.insert_batch(
+            table,
+            jnp.stack([lo, hi]),
+            jnp.stack([hi, lo]),
+            jnp.stack([ok, ok]),
+        )
+        return (table, local, glob), (
+            jnp.stack([local[lo], local[hi]]),
+            glob,
+        )
+
+    (table, local, glob), (local_trace, global_trace) = jax.lax.scan(
+        step, (state.table, state.local, state.global_count), (src, dst, mask)
+    )
+    return TriangleCountState(table, local, glob), local_trace, global_trace
+
+
+class ExactTriangleCount:
+    """Host-facing runner: continuous (key, count) updates, key -1 = global."""
+
+    def __init__(self, cfg: Optional[StreamConfig] = None):
+        self._kernel = jax.jit(triangle_update)
+
+    def run(self, stream) -> OutputStream:
+        def records():
+            state = init_triangle_state(stream.cfg)
+            for batch in stream.batches():
+                state, local_trace, global_trace = self._kernel(
+                    state, batch.src, batch.dst, batch.mask
+                )
+                l_h = np.asarray(local_trace)
+                g_h = np.asarray(global_trace)
+                m_h = np.asarray(batch.mask)
+                s_h = np.asarray(batch.src)
+                d_h = np.asarray(batch.dst)
+                for i in np.nonzero(m_h)[0]:
+                    u, v = int(min(s_h[i], d_h[i])), int(max(s_h[i], d_h[i]))
+                    yield (u, int(l_h[i, 0]))
+                    yield (v, int(l_h[i, 1]))
+                    yield (GLOBAL_KEY, int(g_h[i]))
+            self.final_state = state
+
+        return OutputStream(records)

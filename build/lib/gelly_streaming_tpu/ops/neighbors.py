@@ -1,0 +1,99 @@
+"""Batched capacity-bounded neighbor tables (device adjacency state).
+
+The reference keeps per-key adjacency as JVM collections inside stateful
+operators: per-key ``HashSet<Edge>`` for distinct (SimpleEdgeStream.java:309-323)
+and per-vertex ``TreeSet`` for buildNeighborhood (SimpleEdgeStream.java:540-560).
+The TPU-native state is a dense table ``nbrs: int32[C, D]`` (-1 = empty slot)
+plus ``deg: int32[C]``, updated for a whole micro-batch in one vectorized pass:
+sort rows by source, rank within group, scatter to ``deg[src] + rank``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gelly_streaming_tpu.ops import segments
+
+
+class NeighborTable(NamedTuple):
+    """Pytree state: padded adjacency rows + row occupancy + overflow counter."""
+
+    nbrs: jax.Array  # int32[C, D], -1 = empty
+    deg: jax.Array  # int32[C]
+    dropped: jax.Array  # int32[] — rows lost to capacity overflow (observability)
+
+
+def init_table(capacity: int, max_degree: int) -> NeighborTable:
+    return NeighborTable(
+        nbrs=jnp.full((capacity, max_degree), -1, dtype=jnp.int32),
+        deg=jnp.zeros((capacity,), dtype=jnp.int32),
+        dropped=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def contains_batch(
+    table: NeighborTable, src: jax.Array, dst: jax.Array
+) -> jax.Array:
+    """For each row i: is dst[i] already in N(src[i])?  Vectorized [B, D] compare."""
+    rows = table.nbrs[src]  # [B, D]
+    return jnp.any(rows == dst[:, None], axis=1)
+
+
+def insert_batch(
+    table: NeighborTable,
+    src: jax.Array,
+    dst: jax.Array,
+    mask: jax.Array,
+) -> NeighborTable:
+    """Append dst[i] to N(src[i]) for every masked row, in one vectorized pass.
+
+    Caller is responsible for dedup (contains_batch + in-batch first-occurrence);
+    this routine appends unconditionally.  Rows that would exceed a row's
+    capacity D are dropped and counted in ``dropped``.
+    """
+    capacity, max_degree = table.nbrs.shape
+    rank = segments.occurrence_rank(src, mask)
+    pos = table.deg[src] + rank
+    ok = mask & (pos < max_degree)
+    # Flat scatter: row-major slot index; masked/overflow rows write to a
+    # sacrificial slot past the end (dropped by the scatter's OOB semantics).
+    flat_idx = jnp.where(ok, src * max_degree + pos, capacity * max_degree)
+    nbrs = (
+        table.nbrs.reshape(-1)
+        .at[flat_idx]
+        .set(jnp.where(ok, dst, -1), mode="drop")
+        .reshape(capacity, max_degree)
+    )
+    deg = table.deg.at[jnp.where(ok, src, 0)].add(ok.astype(jnp.int32))
+    dropped = table.dropped + jnp.sum((mask & ~ok).astype(jnp.int32))
+    return NeighborTable(nbrs=nbrs, deg=deg, dropped=dropped)
+
+
+def insert_unique_batch(
+    table: NeighborTable,
+    src: jax.Array,
+    dst: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[NeighborTable, jax.Array]:
+    """Insert only rows not already present (in table or earlier in the batch).
+
+    Returns (new_table, is_new) where is_new marks rows actually inserted — the
+    device analog of the reference's ``HashSet.add`` returning true
+    (SimpleEdgeStream.java:313-320).
+    """
+    if mask is None:
+        mask = jnp.ones(src.shape, bool)
+    present = contains_batch(table, src, dst)
+    first = segments.first_occurrence_mask_pairs(src, dst, mask)
+    is_new = mask & ~present & first
+    return insert_batch(table, src, dst, is_new), is_new
+
+
+def gather_rows(table: NeighborTable, vertices: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(neighbors [B, D], valid [B, D]) for a batch of vertices."""
+    rows = table.nbrs[vertices]
+    valid = jnp.arange(table.nbrs.shape[1])[None, :] < table.deg[vertices][:, None]
+    return rows, valid

@@ -1,0 +1,140 @@
+"""Batched key-grouping primitives: the TPU-native replacement for ``keyBy``.
+
+Everywhere the reference routes records through Flink's hash shuffle and mutates
+per-key operator state (e.g. SimpleEdgeStream.java:119,303,492;
+SummaryBulkAggregation.java:78), this framework instead sorts/ranks keys inside a
+padded micro-batch and applies vectorized segment reductions and scatters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouping_key(keys: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    """int32-safe composite key where padding rows never join a valid group.
+
+    Valid keys map to even space (k*2), padding rows to odd space (k*2+1), so a
+    padding row sorts next to — but never inside — a valid group.  Requires
+    0 <= key < 2^30 (the framework caps vertex_capacity accordingly).
+    """
+    k = keys.astype(jnp.int32) * 2
+    if mask is None:
+        return k
+    return k + jnp.where(mask, 0, 1)
+
+
+def occurrence_rank(keys: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    """rank[i] = number of earlier valid rows j<i with keys[j] == keys[i].
+
+    This is what turns per-key *sequential* state updates (the reference's
+    per-record HashMap increments, SimpleEdgeStream.java:461-478) into one
+    vectorized pass: the k-th occurrence of a key inside a batch can compute its
+    running value as ``base[key] + rank``.
+    """
+    k = _grouping_key(keys, mask)
+    order = jnp.argsort(k, stable=True)
+    return _rank_from_grouping(order, segment_boundaries(k[order]))
+
+
+def first_occurrence_mask(
+    keys: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """True for the first valid occurrence of each key within the batch."""
+    first = occurrence_rank(keys, mask) == 0
+    if mask is not None:
+        first = first & mask
+    return first
+
+
+def group_counts(
+    keys: jax.Array, num_groups: int, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """Number of valid rows per key, as a dense [num_groups] array."""
+    ones = jnp.ones(keys.shape, jnp.int32)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0)
+        keys = jnp.where(mask, keys, 0)
+        # masked rows contribute 0 to group 0
+    return jax.ops.segment_sum(ones, keys, num_segments=num_groups)
+
+
+def segment_sum(
+    values: jax.Array,
+    keys: jax.Array,
+    num_groups: int,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    if mask is not None:
+        values = jnp.where(mask, values, jnp.zeros_like(values))
+        keys = jnp.where(mask, keys, 0)
+    return jax.ops.segment_sum(values, keys, num_segments=num_groups)
+
+
+def _rank_from_grouping(order: jax.Array, boundary: jax.Array) -> jax.Array:
+    """Within-group rank (0-based, original order) from a stable grouping
+    ``order`` and the group-start ``boundary`` mask over the sorted keys."""
+    n = order.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(boundary, pos, 0))
+    rank_sorted = pos - seg_start
+    return jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+
+
+def _pair_order(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array]
+) -> Tuple[jax.Array, jax.Array]:
+    """Stable order grouping equal (src, dst) pairs; returns (order, boundary).
+
+    Uses lexsort on (position, dst, grouping-src) so stability is explicit and
+    no int64 composite key is needed.
+    """
+    n = src.shape[0]
+    ks = _grouping_key(src, mask)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    order = jnp.lexsort((pos, dst.astype(jnp.int32), ks))
+    s_sorted = ks[order]
+    d_sorted = dst.astype(jnp.int32)[order]
+    boundary = segment_boundaries(s_sorted) | segment_boundaries(d_sorted)
+    return order, boundary
+
+
+def occurrence_rank_pairs(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """occurrence_rank over composite (src, dst) keys."""
+    order, boundary = _pair_order(src, dst, mask)
+    return _rank_from_grouping(order, boundary)
+
+
+def first_occurrence_mask_pairs(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """True for the first valid occurrence of each (src, dst) pair in the batch."""
+    first = occurrence_rank_pairs(src, dst, mask) == 0
+    if mask is not None:
+        first = first & mask
+    return first
+
+
+def sort_by_key(
+    keys: jax.Array, mask: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Stable grouping order; returns (order, sorted_grouping_keys).
+
+    Valid rows are grouped by key with original order preserved within a group;
+    padding rows sort adjacent to — but never inside — a valid group.
+    """
+    k = _grouping_key(keys, mask)
+    order = jnp.argsort(k, stable=True)
+    return order, k[order]
+
+
+def segment_boundaries(sorted_keys: jax.Array) -> jax.Array:
+    """Boundary mask over sorted grouping keys (True at each new group start)."""
+    return jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]]
+    )

@@ -1,0 +1,61 @@
+"""Device mesh and vertex-space partitioning.
+
+This package is the TPU-native stand-in for the Flink runtime services the
+reference consumes (network shuffle via keyBy, broadcast, all-window gather,
+iteration feedback — SURVEY.md §2.3/§5.8, pom.xml:38-63): a 1-D
+``jax.sharding.Mesh`` over a ``shards`` axis carries the data plane; vertex
+ownership is ``vertex_id % num_shards`` over the dense interned id space
+(the analog of Flink's key-group hashing).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_shards: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the first ``num_shards`` devices (default: all)."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = num_shards or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} shards but only {len(devs)} devices")
+    return Mesh(np.array(devs[:n]), (SHARD_AXIS,))
+
+
+def owner_of(vertex_ids: np.ndarray, num_shards: int) -> np.ndarray:
+    """Owning shard of each vertex (dense interned ids: modulo spreads load)."""
+    return vertex_ids % num_shards
+
+
+def shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """jax.shard_map with the replication (vma) check disabled.
+
+    The framework's kernels run data-dependent ``while_loop``s whose carries
+    change mesh-variance mid-loop (invariant labels become shard-varying after
+    hooking local edges, then invariant again after pmin) — valid SPMD that the
+    static vma checker rejects.  Handles the check kwarg rename across jax
+    versions.
+    """
+    try:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+def sharded(mesh: Mesh):
+    """Sharding for arrays split on their leading axis."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

@@ -1,0 +1,321 @@
+"""Multi-host ingest plane: watermark agreement and window-close barriers.
+
+The reference inherits cross-worker time agreement from Flink: sources emit
+watermarks, the runtime broadcasts them along dataflow edges, and a window
+fires only when the *minimum* watermark across all input channels passes its
+end (that is what makes `timeWindowAll` correct with parallel sources).  In
+the TPU framework the analogous boundary is between *ingest hosts* feeding a
+multi-host mesh over DCN: every host parses + timestamps its partition of the
+edge stream locally, and a tumbling pane may close only once **every** host's
+watermark has passed the pane end — otherwise a straggler host could still
+hold edges for it.
+
+Two transports, matching the two deployment shapes:
+
+* ``ProcessWatermarkBoard`` + ``multihost_tumbling_windows`` — asynchronous
+  agreement through a shared in-process board (condition variable).  This is
+  the N-ingest-threads-on-one-host shape and the test/simulation transport
+  (the MiniCluster analog).
+* ``lockstep_tumbling_windows`` over an ``allgather`` callable — synchronous
+  agreement for real multi-process runs: every host contributes one watermark
+  per round via a collective (``JaxWatermarkBoard.allgather`` =
+  ``multihost_utils.process_allgather`` over DCN), hosts that exhaust their
+  stream keep participating with an END sentinel until all are done.  The
+  collective doubles as the window-close barrier.
+
+Both yield the same contract: every host emits a share (possibly empty) of
+exactly the same pane-id sequence in the same order, so downstream cross-host
+combines (psum over the mesh, or host gathers) can pair shares positionally.
+
+Late edges — edges for a pane that already closed globally — are dropped with
+a warning (Flink's default beyond allowed lateness), via an overridable
+``on_late`` hook.  Device-side collectives (the data plane) are unchanged:
+they ride ICI inside shard_map; this module aligns only the *time* plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Iterator, NamedTuple, Optional
+
+import numpy as np
+
+from gelly_streaming_tpu.core.types import EdgeBatch
+from gelly_streaming_tpu.core.windows import PaneAssembler, WindowPane, _batch_to_host
+
+logger = logging.getLogger(__name__)
+
+END = int(np.iinfo(np.int64).max)  # "this host is finished" watermark sentinel
+
+
+class HostEnv(NamedTuple):
+    """This process's coordinates in the multi-host job."""
+
+    host_id: int
+    num_hosts: int
+
+
+def distributed_env(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> HostEnv:
+    """Resolve (and if needed initialize) the multi-host environment.
+
+    Single-process runs return ``HostEnv(0, 1)`` without touching
+    jax.distributed.  Multi-host runs pass coordinator parameters once, first
+    thing in the program (before device use), exactly like any jax multi-host
+    job; subsequent calls just read process_index/count.
+    """
+    import jax
+
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return HostEnv(jax.process_index(), jax.process_count())
+
+
+# ---------------------------------------------------------------------------
+# Watermark agreement transports
+# ---------------------------------------------------------------------------
+
+
+class ProcessWatermarkBoard:
+    """Thread-safe minimum-watermark board for N ingest workers in one process.
+
+    Watermarks are window ids (time // window_ms), monotonically nondecreasing
+    per host.  ``finish`` marks a host done (it no longer constrains the
+    minimum — Flink's Long.MAX_VALUE watermark on source close) while its last
+    real pane id stays visible through ``global_max_pane``.
+    """
+
+    END = END
+
+    def __init__(self, num_hosts: int):
+        self._marks = [-1] * num_hosts
+        self._max_pane = -1  # highest real (non-END) pane id any host reported
+        self._cond = threading.Condition()
+
+    def report(self, host_id: int, watermark: int) -> None:
+        with self._cond:
+            if watermark < self._marks[host_id]:
+                raise ValueError(
+                    f"watermark of host {host_id} went backwards: "
+                    f"{watermark} < {self._marks[host_id]}"
+                )
+            self._marks[host_id] = watermark
+            if watermark != END:
+                self._max_pane = max(self._max_pane, watermark)
+            self._cond.notify_all()
+
+    def finish(self, host_id: int) -> None:
+        self.report(host_id, END)
+
+    def global_watermark(self) -> int:
+        with self._cond:
+            return min(self._marks)
+
+    def global_max_pane(self) -> int:
+        with self._cond:
+            return self._max_pane
+
+    def wait_global(self, watermark: int, timeout: Optional[float] = None) -> int:
+        """Block until the global (min) watermark reaches ``watermark``."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: min(self._marks) >= watermark, timeout=timeout
+            )
+            if not ok:
+                raise TimeoutError(
+                    f"global watermark stuck at {min(self._marks)} "
+                    f"< {watermark} (per-host: {self._marks})"
+                )
+            return min(self._marks)
+
+
+class JaxWatermarkBoard:
+    """Cross-process transport: one allgather over DCN per agreement round.
+
+    ``allgather`` is a collective — every participating process must call it
+    once per round (``lockstep_tumbling_windows`` guarantees that cadence,
+    END-padding hosts whose streams end early).
+    """
+
+    def allgather(self, local_watermark: int) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.atleast_1d(
+            multihost_utils.process_allgather(
+                np.asarray(local_watermark, np.int64)
+            )
+        )
+
+
+def _default_on_late(pane_id: int, count: int) -> None:
+    logger.warning(
+        "dropping %d late edge(s) for already-closed pane %d", count, pane_id
+    )
+
+
+# ---------------------------------------------------------------------------
+# Watermark-gated window assignment
+# ---------------------------------------------------------------------------
+
+
+class _GatedEmitter:
+    """Orders pane closes behind the agreed watermark.
+
+    Single point for the close-and-advance step of both gated assigners, so
+    close semantics (empty shares, bookkeeping) cannot diverge between the
+    async-board and lockstep paths.  ``through`` is the highest pane id closed
+    so far (the late-edge boundary).
+    """
+
+    def __init__(self, panes: PaneAssembler):
+        self._panes = panes
+        self.through = -1
+
+    def drain_below(self, upto: int):
+        """Close panes with ids in (through, upto), in order."""
+        for wid in range(self.through + 1, upto):
+            self.through = wid
+            yield self._panes.close(wid)
+
+    def drain_through(self, last: int):
+        """Close panes with ids in (through, last], in order."""
+        return self.drain_below(last + 1)
+
+
+def _ingest_batch(panes, batch, window_ms, emitted_through, on_late):
+    """Append one batch's edges to open panes; returns (local_mark, had_data).
+
+    Edges for panes at or below ``emitted_through`` (already closed globally)
+    are dropped through ``on_late`` — counting them would corrupt closed
+    windows.
+    """
+    src, dst, val, time = _batch_to_host(batch)
+    if len(src) == 0:
+        return -1, False
+    if time is None:
+        raise ValueError(
+            "multi-host windows need event timestamps (the single-pane "
+            "ingestion-time path is single-host only)"
+        )
+    wids = time // window_ms
+    late = wids <= emitted_through
+    if late.any():
+        for wid in np.unique(wids[late]):
+            on_late(int(wid), int((wids == wid).sum()))
+        keep = ~late
+        src, dst, time, wids = src[keep], dst[keep], time[keep], wids[keep]
+        if val is not None:
+            import jax
+
+            val = jax.tree.map(lambda a: a[keep], val)
+        if len(src) == 0:
+            return -1, False
+    panes.add(src, dst, val, time, wids)
+    return int(wids.max()), True
+
+
+def multihost_tumbling_windows(
+    batches: Iterator[EdgeBatch],
+    window_ms: int,
+    host_id: int,
+    board: ProcessWatermarkBoard,
+    timeout: Optional[float] = None,
+    on_late: Callable[[int, int], None] = _default_on_late,
+) -> Iterator[WindowPane]:
+    """This host's share of each tumbling pane, closed on *global* agreement.
+
+    Same pane assembly as core/windows.py:assign_tumbling_windows, but a pane
+    [w*window_ms, (w+1)*window_ms) is yielded only once every host's watermark
+    has passed w — the straggler-safe close.  All hosts yield shares (possibly
+    empty) of the same pane ids in the same order.
+    """
+    panes = PaneAssembler(window_ms)
+    em = _GatedEmitter(panes)
+    local_mark = -1  # this host's watermark: max pane id seen, never regressing
+
+    try:
+        for batch in batches:
+            mark, had_data = _ingest_batch(
+                panes, batch, window_ms, em.through, on_late
+            )
+            if not had_data:
+                continue
+            if mark > local_mark:
+                local_mark = mark
+                board.report(host_id, local_mark)
+            # Close every pane the *global* watermark has passed: all hosts
+            # have moved beyond it, so no host can still hold edges for it.  A
+            # host checks lazily (at its next batch), which only delays
+            # emission, never loses or double-emits a pane.  Empty shares keep
+            # the sequence aligned across hosts.
+            yield from em.drain_below(board.global_watermark())
+    finally:
+        # Always release the peers — a crashing source or an abandoned pane
+        # consumer must not leave other hosts blocked in wait_global forever.
+        board.finish(host_id)
+
+    # End of this host's stream: wait for everyone, then every host flushes
+    # the same tail — panes up to the globally highest reported pane id, with
+    # empty shares where this host held nothing.
+    board.wait_global(END, timeout=timeout)
+    yield from em.drain_through(board.global_max_pane())
+
+
+def lockstep_tumbling_windows(
+    batches: Iterator[EdgeBatch],
+    window_ms: int,
+    allgather: Callable[[int], np.ndarray],
+    on_late: Callable[[int, int], None] = _default_on_late,
+) -> Iterator[WindowPane]:
+    """Collective-transport variant for real multi-process (DCN) runs.
+
+    Protocol: one ``allgather(local_watermark)`` round per ingested batch.
+    Panes below the round's global minimum close immediately (the collective
+    is the barrier).  A host whose stream ends keeps joining rounds with the
+    END sentinel until every host reports END, so the collective cadence
+    always matches across processes even with unequal batch counts; the final
+    flush then emits the same tail of pane ids on every host.
+
+    Pass ``JaxWatermarkBoard().allgather`` in a jax.distributed job, or any
+    callable with allgather semantics (tests use a thread barrier).
+    """
+    panes = PaneAssembler(window_ms)
+    em = _GatedEmitter(panes)
+    local_mark = -1
+    max_pane = -1  # running max of real pane ids seen anywhere
+
+    def agree(mark: int):
+        nonlocal max_pane
+        marks = allgather(mark)
+        real = marks[marks != END]
+        if len(real):
+            max_pane = max(max_pane, int(real.max()))
+        return int(marks.min())
+
+    for batch in batches:
+        mark, had_data = _ingest_batch(
+            panes, batch, window_ms, em.through, on_late
+        )
+        if had_data:
+            local_mark = max(local_mark, mark)
+        yield from em.drain_below(agree(local_mark))
+
+    while True:
+        # Stream done here, but other hosts may still be ingesting: keep
+        # joining their rounds with the END sentinel, closing panes as the
+        # global watermark advances, until everyone reports END.  (A raising
+        # source cannot be papered over here — the collective has no side
+        # channel — so peers' rounds will time out in their transport.)
+        agreed = agree(END)
+        if agreed == END:
+            break
+        yield from em.drain_below(agreed)
+    yield from em.drain_through(max_pane)

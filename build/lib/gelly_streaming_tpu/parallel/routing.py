@@ -1,0 +1,100 @@
+"""Edge routing: the keyBy shuffle, TPU-style.
+
+The reference's ``keyBy`` is a Netty network shuffle routing each record to the
+subtask owning its key (SimpleEdgeStream.java:119,303,492;
+SummaryBulkAggregation.java:78).  Here routing happens in two places:
+
+  * host_route: the ingest plane — the host buckets a window pane's edges by
+    owning shard and pads to a fixed per-shard capacity, producing the stacked
+    [S, B] arrays a ``shard_map`` program consumes (the keyBy-from-source
+    analog; SURVEY.md §5.8 "control/ingest plane").
+  * device_route: the data plane — re-keying mid-pipeline without leaving the
+    mesh, via in-shard bucketing + ``lax.all_to_all`` over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.ops import segments
+from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
+
+
+class RoutedEdges(NamedTuple):
+    """Stacked per-shard edge arrays: leading axis = shard."""
+
+    src: np.ndarray  # [S, B]
+    dst: np.ndarray  # [S, B]
+    mask: np.ndarray  # [S, B]
+
+
+def host_route(
+    src: np.ndarray,
+    dst: np.ndarray,
+    num_shards: int,
+    key: str = "src",
+    capacity: Optional[int] = None,
+) -> RoutedEdges:
+    """Bucket edges by owner shard on the host, padding each bucket to a common
+    capacity.  ``key`` picks the routing key ("src" or "dst")."""
+    owner = (src if key == "src" else dst) % num_shards
+    counts = np.bincount(owner, minlength=num_shards)
+    cap = capacity or (int(counts.max()) if len(src) else 1)
+    s = np.zeros((num_shards, cap), np.int32)
+    d = np.zeros((num_shards, cap), np.int32)
+    m = np.zeros((num_shards, cap), bool)
+    for shard in range(num_shards):
+        sel = owner == shard
+        n = min(int(sel.sum()), cap)
+        s[shard, :n] = src[sel][:n]
+        d[shard, :n] = dst[sel][:n]
+        m[shard, :n] = True
+    return RoutedEdges(s, d, m)
+
+
+def device_route(
+    src: jax.Array,
+    dst: jax.Array,
+    mask: jax.Array,
+    num_shards: int,
+    capacity: int,
+    key: str = "src",
+    axis_name: str = SHARD_AXIS,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Re-key this shard's edges to their owner shards (call inside shard_map).
+
+    Buckets local edges into a [S, cap] send buffer (scatter by per-owner
+    occurrence rank), then ``all_to_all`` swaps buffers so each shard receives
+    the edges it owns.  Overflow beyond ``cap`` per (sender, receiver) pair is
+    dropped — size cap for the worst expected skew (SURVEY.md §7 notes salting
+    for power-law keys as future work).
+
+    Returns (src, dst, mask) of the received edges, flattened to [S * cap].
+    """
+    routing_key = src if key == "src" else dst
+    owner = jnp.where(mask, routing_key % num_shards, num_shards - 1)
+    rank = segments.occurrence_rank(owner, mask)
+    ok = mask & (rank < capacity)
+    slot = jnp.where(ok, owner * capacity + rank, num_shards * capacity)
+
+    def build(buf_fill, values):
+        buf = jnp.full((num_shards * capacity,), buf_fill, values.dtype)
+        return buf.at[slot].set(jnp.where(ok, values, buf_fill), mode="drop").reshape(
+            num_shards, capacity
+        )
+
+    send_src = build(0, src)
+    send_dst = build(0, dst)
+    send_mask = build(False, ok)
+    recv_src = jax.lax.all_to_all(send_src, axis_name, 0, 0, tiled=False)
+    recv_dst = jax.lax.all_to_all(send_dst, axis_name, 0, 0, tiled=False)
+    recv_mask = jax.lax.all_to_all(send_mask, axis_name, 0, 0, tiled=False)
+    return (
+        recv_src.reshape(-1),
+        recv_dst.reshape(-1),
+        recv_mask.reshape(-1),
+    )

@@ -1,0 +1,137 @@
+"""Capacity-bounded adjacency summary with level-bounded BFS (spanner support).
+
+Reference: summaries/AdjacencyListGraph.java — an undirected ``Map<K, HashSet<K>>``
+with ``addEdge`` inserting both directions (:46-68) and ``boundedBFS(src, trg, k)``
+answering "is trg within k hops of src" (:79-117).  The array-native form is a
+padded neighbor table ``nbrs: int32[C, D]`` (-1 = empty) plus ``deg: int32[C]``;
+bounded BFS is k steps of frontier expansion over the table — a dense, jittable
+reachability kernel instead of a queue.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_table(capacity: int, max_degree: int) -> Tuple[jax.Array, jax.Array]:
+    nbrs = jnp.full((capacity, max_degree), -1, dtype=jnp.int32)
+    deg = jnp.zeros((capacity,), dtype=jnp.int32)
+    return nbrs, deg
+
+
+def contains_edge(nbrs: jax.Array, u: jax.Array, v: jax.Array) -> jax.Array:
+    """Vectorized membership: is v in N(u)?  u, v scalars or [B]."""
+    row = nbrs[u]
+    return jnp.any(row == v[..., None] if jnp.ndim(v) else row == v, axis=-1)
+
+
+def add_undirected_edge(
+    nbrs: jax.Array, deg: jax.Array, u: jax.Array, v: jax.Array, enabled=True
+) -> Tuple[jax.Array, jax.Array]:
+    """Idempotently insert u-v in both directions (AdjacencyListGraph.java:46-68).
+
+    Scalar (per-edge) form, used inside lax.scan by the spanner fold, which is
+    sequential by construction (each admission decision depends on the previous).
+    Silently drops on row overflow (capacity-bounded summary).
+    """
+    # Presence in either row counts: a previous overflow may have left the edge
+    # half-inserted, and re-inserting the other half would duplicate entries.
+    present = jnp.any(nbrs[u] == v) | jnp.any(nbrs[v] == u) | (u == v)
+    # All-or-nothing: only insert when BOTH rows have room, keeping the table
+    # symmetric under overflow (the summary stays a valid undirected graph).
+    room = (deg[u] < nbrs.shape[1]) & (deg[v] < nbrs.shape[1])
+    do = enabled & ~present & room
+
+    def apply(operand):
+        nbrs, deg = operand
+        nbrs = nbrs.at[u, deg[u]].set(v)
+        nbrs = nbrs.at[v, deg[v]].set(u)
+        deg = deg.at[u].add(1)
+        deg = deg.at[v].add(1)
+        return nbrs, deg
+
+    return jax.lax.cond(do, apply, lambda x: x, (nbrs, deg))
+
+
+def bounded_bfs(
+    nbrs: jax.Array, src: jax.Array, trg: jax.Array, k: int
+) -> jax.Array:
+    """True iff trg is reachable from src within k hops
+    (AdjacencyListGraph.java:79-117).  Dense frontier expansion: each step
+    scatters the neighbor rows of all reached vertices.
+    """
+    capacity = nbrs.shape[0]
+    reached = jnp.zeros((capacity,), bool).at[src].set(True)
+
+    def body(_, reached):
+        rows = jnp.where(reached[:, None], nbrs, -1)
+        flat = rows.reshape(-1)
+        valid = flat >= 0
+        new = jnp.zeros((capacity,), bool).at[jnp.where(valid, flat, 0)].max(valid)
+        return reached | new
+
+    reached = jax.lax.fori_loop(0, k, body, reached)
+    return reached[trg]
+
+
+# Compiled once per shape; the host wrappers are called per edge.
+_add_edge_j = jax.jit(add_undirected_edge)
+_bounded_bfs_j = jax.jit(bounded_bfs, static_argnames="k")
+
+
+class AdjacencyListGraph:
+    """Host-facing wrapper with the reference's object API (for tests/algorithms)."""
+
+    def __init__(self, capacity: int = 1 << 10, max_degree: int = 64):
+        self.capacity = capacity
+        self.max_degree = max_degree
+        self.nbrs, self.deg = init_table(capacity, max_degree)
+
+    @classmethod
+    def from_state(cls, nbrs, deg) -> "AdjacencyListGraph":
+        """Wrap existing (nbrs, deg) arrays (e.g. a Spanner summary) as a view."""
+        g = cls.__new__(cls)
+        g.capacity = int(nbrs.shape[0])
+        g.max_degree = int(nbrs.shape[1])
+        g.nbrs = nbrs
+        g.deg = deg
+        return g
+
+    def reset(self) -> None:
+        self.nbrs, self.deg = init_table(self.capacity, self.max_degree)
+
+    def add_edge(self, u: int, v: int) -> None:
+        self.nbrs, self.deg = _add_edge_j(
+            self.nbrs, self.deg, jnp.int32(u), jnp.int32(v)
+        )
+
+    def bounded_bfs(self, src: int, trg: int, k: int) -> bool:
+        return bool(_bounded_bfs_j(self.nbrs, jnp.int32(src), jnp.int32(trg), k=k))
+
+    def adjacency_map(self) -> Dict[int, Set[int]]:
+        """Materialize as the reference's Map<K, HashSet<K>> view (tests only)."""
+        nbrs = np.asarray(self.nbrs)
+        deg = np.asarray(self.deg)
+        out: Dict[int, Set[int]] = {}
+        for v in np.nonzero(deg > 0)[0]:
+            out[int(v)] = set(int(x) for x in nbrs[v, : deg[v]])
+        return out
+
+    def edges(self) -> Set[Tuple[int, int]]:
+        """Canonical (min, max) undirected edge set currently stored."""
+        out = set()
+        for v, ns in self.adjacency_map().items():
+            for n in ns:
+                out.add((min(v, n), max(v, n)))
+        return out
+
+    def __str__(self) -> str:
+        m = self.adjacency_map()
+        parts = [
+            f"{v}={sorted(ns)}" for v, ns in sorted(m.items())
+        ]
+        return "{" + ", ".join(parts) + "}"

@@ -1,0 +1,69 @@
+"""Bipartiteness summary view with the reference's Candidates rendering.
+
+Reference: summaries/Candidates.java — ``(Boolean, TreeMap<componentId,
+Map<vertexId, SignedVertex>>)`` (:27) built edge-by-edge with sign-conflict
+detection (:61-74) and pairwise merge-with-parity (:77-192); any conflict
+collapses to the global fail sentinel ``(false,{})`` (:194-196).
+
+The TPU-native summary is the doubled-vertex parity union-find
+(ops/unionfind.py): node 2v = "v side A", 2v+1 = "v side B"; an odd cycle
+collapses a vertex's two sides into one component.  This class is the host view
+that renders that array state in Candidates' exact toString format, e.g.
+``(true,{1={1=(1,true), 2=(2,false)}})`` — component ids are the component's
+minimum vertex; a sign is true iff the vertex lies on the same side as that
+minimum vertex (matching the reference's min-endpoint-positive convention,
+BipartitenessCheck.java:52-59).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.ops import unionfind as uf
+
+
+class Candidates:
+    def __init__(self, parent2, seen):
+        self.parent2 = parent2  # int32[2C] doubled-space union-find
+        self.seen = seen  # bool[C]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.parent2.shape[0]) // 2
+
+    def is_bipartite(self) -> bool:
+        return bool(uf.is_bipartite(self.parent2, self.seen))
+
+    def components(self) -> Dict[int, Dict[int, Tuple[int, bool]]]:
+        """component-min-vertex -> {vertex -> (vertex, same_side_as_min)}."""
+        p = np.asarray(uf.compress(self.parent2))
+        seen = np.nonzero(np.asarray(self.seen))[0]
+        even = p[2 * seen]
+        odd = p[2 * seen + 1]
+        comp_key = np.minimum(even, odd)
+        comps: Dict[int, Dict[int, Tuple[int, bool]]] = {}
+        for key in np.unique(comp_key):
+            members = seen[comp_key == key]
+            m = int(members.min())
+            m_side = p[2 * m]
+            entry = {}
+            for v in members:
+                entry[int(v)] = (int(v), bool(p[2 * v] == m_side))
+            comps[m] = entry
+        return comps
+
+    def __str__(self) -> str:
+        if not self.is_bipartite():
+            return "(false,{})"
+        comps = self.components()
+        comp_strs = []
+        for key in sorted(comps):
+            inner = ", ".join(
+                f"{v}=({v},{'true' if side else 'false'})"
+                for v, (_, side) in sorted(comps[key].items())
+            )
+            comp_strs.append(f"{key}={{{inner}}}")
+        return "(true,{" + ", ".join(comp_strs) + "})"

@@ -1,0 +1,67 @@
+"""Shared wire-ingest measurement harness.
+
+One implementation of the warmup -> prefetched-transfer -> jitted-fold ->
+meter pattern used by bench.py and the measurement programs, so ingest-path
+changes (wire encodings, prefetch policy) land in one place.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import numpy as np
+
+
+def wire_stream_fold(
+    src: np.ndarray,
+    dst: np.ndarray,
+    capacity: int,
+    batch: int,
+    make_fold: Callable,
+    init_state: Callable[[], object],
+    device=None,
+    depth: int = 8,
+) -> Tuple[float, int, object]:
+    """Stream (src, dst) through the wire ingest path into a jitted fold.
+
+    ``make_fold(batch, width)`` returns ``fold(state, wire_buf) -> state``
+    (state is a donated pytree); ``init_state()`` builds the initial state.
+    The first batch is unmetered compile warmup, so ``batch`` shrinks when
+    needed to keep at least two batches; only full batches fold (static
+    kernel shapes).  Returns (edges_per_sec, edges_folded, final_state).
+    """
+    import jax
+
+    from gelly_streaming_tpu.io import wire
+    from gelly_streaming_tpu.utils.metrics import ThroughputMeter
+
+    num_edges = src.shape[0]
+    if num_edges < 2:
+        raise ValueError("need at least 2 edges (one warmup + one metered batch)")
+    batch = min(batch, num_edges // 2)
+
+    if device is None:
+        device = jax.devices()[0]
+    width = wire.width_for_capacity(capacity)
+
+    fold = jax.jit(make_fold(batch, width), donate_argnums=0)
+    state = jax.tree.map(lambda a: jax.device_put(a, device), init_state())
+
+    n_batches = num_edges // batch  # >= 2 by construction
+    w0 = jax.device_put(wire.pack_edges(src[:batch], dst[:batch], width), device)
+    state = fold(state, w0)
+    jax.block_until_ready(state)
+
+    def batches():
+        for i in range(1, n_batches):
+            yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
+
+    meter = ThroughputMeter()
+    meter.start()
+    with wire.WirePrefetcher(batches(), width, device, depth=depth) as pf:
+        for buf, n in pf:
+            state = fold(state, buf)
+            meter.record_batch(n)
+    jax.block_until_ready(state)
+    meter.stop()
+    return meter.edges_per_sec, n_batches * batch, state

@@ -1,0 +1,86 @@
+"""Observability: throughput and window-latency counters, profiler hooks.
+
+The reference has none in-repo (log4j root logger is OFF,
+src/main/resources/log4j.properties:22; the only measurement is an ad-hoc
+getNetRuntime print, CentralizedWeightedMatching.java:62-64 — SURVEY.md §5.1/5.5).
+The TPU build makes edges/sec and per-window latency first-class, plus an
+optional jax.profiler trace context for device-level inspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional
+
+
+class ThroughputMeter:
+    """Edges/sec over a processing run (count what the device actually saw)."""
+
+    def __init__(self):
+        self.edges = 0
+        self.batches = 0
+        self._start: Optional[float] = None
+        self._stop: Optional[float] = None
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def record_batch(self, num_edges: int) -> None:
+        if self._start is None:
+            self.start()
+        self.edges += int(num_edges)
+        self.batches += 1
+
+    def stop(self) -> None:
+        self._stop = time.perf_counter()
+
+    @property
+    def elapsed(self) -> float:
+        if self._start is None:
+            return 0.0
+        end = self._stop if self._stop is not None else time.perf_counter()
+        return end - self._start
+
+    @property
+    def edges_per_sec(self) -> float:
+        return self.edges / self.elapsed if self.elapsed > 0 else 0.0
+
+
+class WindowLatencyRecorder:
+    """Wall-clock latency from a window's close to its emitted result."""
+
+    def __init__(self):
+        self.latencies_ms: List[float] = []
+        self._open: Optional[float] = None
+
+    def window_closed(self) -> None:
+        self._open = time.perf_counter()
+
+    def result_emitted(self) -> None:
+        if self._open is not None:
+            self.latencies_ms.append((time.perf_counter() - self._open) * 1e3)
+            self._open = None
+
+    def percentile(self, p: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        xs = sorted(self.latencies_ms)
+        idx = min(int(len(xs) * p / 100.0), len(xs) - 1)
+        return xs[idx]
+
+    @property
+    def p50_ms(self) -> float:
+        return self.percentile(50)
+
+
+@contextlib.contextmanager
+def profiled(trace_dir: Optional[str] = None):
+    """jax.profiler trace context; no-op when trace_dir is None."""
+    if trace_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

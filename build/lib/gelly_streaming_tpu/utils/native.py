@@ -1,0 +1,130 @@
+"""Loader for the native (C++) host-plane helpers.
+
+Builds ``native/edge_parser.cpp`` into a shared library on first use (g++ is in
+the image; pybind11 is not, so the boundary is a plain C ABI via ctypes) and
+exposes a typed wrapper.  Falls back cleanly to ``None`` when no compiler is
+available — callers keep a pure-numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def _find_src() -> str:
+    """The C++ source: repo layout (native/) or installed package data
+    (gelly_streaming_tpu/native_src/, shipped so pip installs keep the native
+    ingest path instead of silently falling back to numpy)."""
+    for cand in (
+        os.path.join(_REPO_ROOT, "native", "edge_parser.cpp"),
+        os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+
+
+_SRC = _find_src()
+# Prefer the repo-layout build dir; installed (possibly read-only) packages
+# fall back to a per-user cache.
+_BUILD_DIRS = [
+    os.path.join(_REPO_ROOT, "native", "build"),
+    os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "gelly_streaming_tpu",
+    ),
+]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+    except OSError:
+        # source not shipped: use a prebuilt .so if present, else fall back
+        for d in _BUILD_DIRS:
+            so = os.path.join(d, "libgelly_ingest.so")
+            if os.path.exists(so):
+                return so
+        return None
+    for d in _BUILD_DIRS:
+        so = os.path.join(d, "libgelly_ingest.so")
+        if os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
+            return so
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o"]
+    for d in _BUILD_DIRS:
+        so = os.path.join(d, "libgelly_ingest.so")
+        try:
+            os.makedirs(d, exist_ok=True)
+            subprocess.run(
+                cmd + [so], check=True, capture_output=True, timeout=120
+            )
+            return so
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+            continue
+    return None
+
+
+def load_ingest_lib():
+    """The compiled ingest library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.count_rows.argtypes = [ctypes.c_char_p]
+        lib.count_rows.restype = ctypes.c_int64
+        lib.fill_edges.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fill_edges.restype = ctypes.c_int64
+        lib.cc_baseline.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.cc_baseline.restype = ctypes.c_int64
+        # A prebuilt .so may predate newer symbols; bind them only when present
+        # so callers can keep their pure-numpy fallbacks instead of crashing.
+        if hasattr(lib, "pack_edges"):
+            lib.pack_edges.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.pack_edges.restype = ctypes.c_int64
+        if hasattr(lib, "pack_edges40"):
+            lib.pack_edges40.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.pack_edges40.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
