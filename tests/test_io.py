@@ -130,3 +130,17 @@ def test_checkpoint_structure_mismatch(tmp_path):
     save_state(path, {"a": jnp.zeros((4,))})
     with pytest.raises(ValueError, match="mismatch"):
         load_state(path, {"a": jnp.zeros((8,))})
+
+
+def test_native_src_matches_canonical_source():
+    """The wheel ships gelly_streaming_tpu/native_src/edge_parser.cpp as a real
+    file (not a symlink — symlinks break on checkouts without symlink support,
+    silently degrading ingest to the numpy fallback).  Keep it byte-identical
+    to the canonical native/edge_parser.cpp."""
+    import pathlib
+
+    pkg = pathlib.Path(__file__).resolve().parent.parent
+    shipped = pkg / "gelly_streaming_tpu" / "native_src" / "edge_parser.cpp"
+    canonical = pkg / "native" / "edge_parser.cpp"
+    assert not shipped.is_symlink()
+    assert shipped.read_bytes() == canonical.read_bytes()
