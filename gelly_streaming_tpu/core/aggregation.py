@@ -91,6 +91,103 @@ class SummaryAggregation:
             self._combine_cache = jax.jit(self.combine)
         return self._combine_cache
 
+    # -- packed-wire fast path ------------------------------------------------
+    #
+    # The reference's aggregation pipeline runs *inside* the Flink runtime —
+    # serialization, shuffle and windowing are the framework's own data plane
+    # (SummaryBulkAggregation.java:76-83 over pom.xml:38-63 services).  The
+    # equivalent here: when the source exposes packed-wire arrays (value-less,
+    # untimed — EdgeStream.from_arrays / file_stream), `run()` streams packed
+    # buffers through WirePrefetcher into ONE jitted fused step per micro-batch
+    # (device-side unpack -> the stream's stages -> updateFun) with the whole
+    # carry donated.  Untimed streams form a single global pane, and updateFun
+    # is a fold over edges, so folding batch-by-batch into one running state is
+    # exactly the single-partition pane fold of the simulated path.
+
+    def _wire_eligible(self, stream, checkpoint_path) -> bool:
+        return (
+            checkpoint_path is None
+            and getattr(stream, "_wire_arrays", None) is not None
+            and self._num_partitions(stream.cfg) == 1
+        )
+
+    def _wire_fused_step(self, stream, batch: int, width):
+        """Jitted (stage-states, summary), wire-buffer -> carry step, cached so
+        repeated runs over the same stream/shape reuse the compiled kernel."""
+        key = (id(stream._stages), stream.cfg, batch, str(width), "wire")
+        cache = getattr(self, "_wire_step_cache", None)
+        if cache is None:
+            cache = self._wire_step_cache = {}
+        if key in cache:
+            return cache[key]
+        from gelly_streaming_tpu.core.types import EdgeBatch
+        from gelly_streaming_tpu.io import wire
+
+        stages = stream._stages
+
+        def tail(carry, src, dst, mask):
+            states, summary = carry
+            b = EdgeBatch(src=src, dst=dst, mask=mask)
+            out_states = []
+            for stage, st in zip(stages, states):
+                st, b = stage.apply(st, b)
+                out_states.append(st)
+            summary = self.update(summary, b.src, b.dst, b.val, b.mask)
+            return (tuple(out_states), summary)
+
+        def fused(carry, buf):
+            s, d = wire.unpack_edges(buf, batch, width)
+            return tail(carry, s, d, jnp.ones((batch,), bool))
+
+        entry = (
+            jax.jit(fused, donate_argnums=0),
+            jax.jit(tail, donate_argnums=0),
+        )
+        cache[key] = entry
+        return entry
+
+    def _wire_records(self, stream) -> Iterator[tuple]:
+        from gelly_streaming_tpu.io import wire
+
+        cfg = stream.cfg
+        src, dst, batch = stream._wire_arrays
+        batch = min(batch, max(len(src), 1))
+        width = wire.width_for_capacity(cfg.vertex_capacity)
+        fused, tail = self._wire_fused_step(stream, batch, width)
+        carry = (
+            tuple(stage.init(cfg) for stage in stream._stages),
+            self.initial_state(cfg),
+        )
+        n_full = len(src) // batch
+
+        def full_batches():
+            for i in range(n_full):
+                yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
+
+        with wire.WirePrefetcher(
+            full_batches(), width, depth=cfg.prefetch_depth
+        ) as pf:
+            for buf, _ in pf:
+                carry = fused(carry, buf)
+        rem = len(src) - n_full * batch
+        if rem:
+            mask = np.zeros((batch,), bool)
+            mask[:rem] = True
+            pad_s = np.zeros((batch,), np.int32)
+            pad_d = np.zeros((batch,), np.int32)
+            pad_s[:rem] = src[n_full * batch :]
+            pad_d[:rem] = dst[n_full * batch :]
+            carry = tail(
+                carry,
+                jnp.asarray(pad_s),
+                jnp.asarray(pad_d),
+                jnp.asarray(mask),
+            )
+        if len(src) == 0:
+            return
+        out = self.transform(carry[1])
+        yield out if isinstance(out, tuple) else (out,)
+
     def _checkpoint_like(self, cfg):
         """Checkpoint structure: summary + presence flag + stream position.
 
@@ -125,6 +222,8 @@ class SummaryAggregation:
         in the reference's Merger.  The untimed single global pane resumes
         only for an unchanged replay (it has no sub-pane position — a longer
         replayed stream's extra untimed edges would be skipped with it)."""
+        if self._wire_eligible(stream, checkpoint_path):
+            return OutputStream(lambda: self._wire_records(stream))
         cfg = stream.cfg
         window_ms = self.window_ms or cfg.window_ms
         n_parts = self._num_partitions(cfg)

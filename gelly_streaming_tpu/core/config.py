@@ -27,6 +27,8 @@ class StreamConfig:
       window_ms: default tumbling-window length in milliseconds (the reference's
         per-aggregation mergeWindowTime, SummaryBulkAggregation.java:79).
       tree_degree: fan-in of the tree combine (SummaryTreeReduce.java:53-64 analog).
+      prefetch_depth: packed-wire transfers kept in flight ahead of the device
+        consumer on the fast ingest path (io/wire.py WirePrefetcher).
     """
 
     vertex_capacity: int = 1 << 16
@@ -35,6 +37,7 @@ class StreamConfig:
     num_shards: int = 1
     window_ms: int = 1000
     tree_degree: int = 2
+    prefetch_depth: int = 8
 
     def __post_init__(self):
         if self.vertex_capacity <= 0:

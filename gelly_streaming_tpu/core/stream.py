@@ -98,10 +98,16 @@ class EdgeStream:
         source_factory: Callable[[], Iterator[EdgeBatch]],
         cfg: StreamConfig,
         stages: Tuple[Stage, ...] = (),
+        wire_arrays: Optional[Tuple[np.ndarray, np.ndarray, int]] = None,
     ):
         self._source_factory = source_factory
         self.cfg = cfg
         self._stages = stages
+        # (src, dst, batch_size) host arrays backing the packed-wire fast path
+        # (core/aggregation.py): present only for value-less, untimed sources,
+        # and preserved through stage-adding transforms (stages run in-jit
+        # after the device-side unpack, so packing commutes with them).
+        self._wire_arrays = wire_arrays
 
     # ---- construction -------------------------------------------------------
 
@@ -137,8 +143,54 @@ class EdgeStream:
     ) -> "EdgeStream":
         return EdgeStream(factory, cfg)
 
+    @staticmethod
+    def from_arrays(
+        src: np.ndarray,
+        dst: np.ndarray,
+        cfg: StreamConfig = StreamConfig(),
+        batch_size: Optional[int] = None,
+    ) -> "EdgeStream":
+        """Value-less, untimed stream over host id arrays.
+
+        This is the framework's fast ingest source: the arrays double as the
+        backing store for the packed-wire transfer path (io/wire.py), which
+        ``aggregate()`` rides when no checkpointing or sharding is requested —
+        the product-API equivalent of the reference's runtime-internal network
+        ingest (SummaryBulkAggregation.java:76-83 runs *inside* Flink's stack).
+        """
+        src = np.ascontiguousarray(src, dtype=np.int32)
+        dst = np.ascontiguousarray(dst, dtype=np.int32)
+        if src.shape != dst.shape:
+            raise ValueError("src/dst length mismatch")
+        if len(src) and (
+            min(src.min(), dst.min()) < 0
+            or max(src.max(), dst.max()) >= cfg.vertex_capacity
+        ):
+            # Out-of-range ids would silently wrap on the packed wire (and
+            # clamp in device scatters) — fail loudly; intern first
+            # (io/interning.py is the framework's bounds guard).
+            raise ValueError(
+                "vertex ids must be in [0, vertex_capacity); intern ids first "
+                "(io.interning.VertexInterner)"
+            )
+        bs = batch_size or cfg.batch_size
+
+        def factory():
+            for i in range(0, max(len(src), 1), bs):
+                chunk_s = src[i : i + bs]
+                if len(chunk_s) == 0:
+                    return
+                yield EdgeBatch.from_arrays(chunk_s, dst[i : i + bs], pad_to=bs)
+
+        return EdgeStream(factory, cfg, wire_arrays=(src, dst, bs))
+
     def _with(self, stage: Stage) -> "EdgeStream":
-        return EdgeStream(self._source_factory, self.cfg, self._stages + (stage,))
+        return EdgeStream(
+            self._source_factory,
+            self.cfg,
+            self._stages + (stage,),
+            wire_arrays=self._wire_arrays,
+        )
 
     # ---- transformations (lazy) --------------------------------------------
 

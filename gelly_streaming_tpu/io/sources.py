@@ -142,6 +142,9 @@ def file_stream(
     src_i = interner.intern_ints(src)
     dst_i = interner.intern_ints(dst)
     bs = batch_size or cfg.batch_size
+    if val is None and tim is None and sign is None:
+        # Value-less untimed files ride the packed-wire fast ingest path.
+        return EdgeStream.from_arrays(src_i, dst_i, cfg, batch_size=bs), interner
     # Timestamps ride through unchanged: tumbling windows are phase-aligned to
     # absolute time (t // window), so shifting would move window boundaries.
     # Device time is int32 ms — streams using epoch-ms should rebase at the
@@ -165,5 +168,4 @@ def generated_stream(
     rng = np.random.default_rng(seed)
     src = rng.integers(0, n_v, num_edges).astype(np.int32)
     dst = rng.integers(0, n_v, num_edges).astype(np.int32)
-    bs = batch_size or cfg.batch_size
-    return EdgeStream.from_batches(_batched(src, dst, None, None, None, bs), cfg)
+    return EdgeStream.from_arrays(src, dst, cfg, batch_size=batch_size)

@@ -59,6 +59,16 @@ def _dense_pane_bound() -> int:
 
 def _pane_triangle_count(src: np.ndarray, dst: np.ndarray) -> int:
     """Exact triangles among a pane's edges (host orchestration, device count)."""
+    if len(src) == 0:
+        return 0
+    max_id = int(max(src.max(), dst.max()))
+    if max_id < _dense_pane_bound():
+        # Ids already fit the dense kernel: ship the raw edge list and let the
+        # device scatter canonicalize/dedup (no host unique, no dense transfer).
+        return pallas_triangles.pane_triangles_dense(
+            src.astype(np.int32), dst.astype(np.int32), max_id + 1
+        )
+    # Sparse id space: compact vertices on the host first.
     lo = np.minimum(src, dst)
     hi = np.maximum(src, dst)
     keep = lo != hi

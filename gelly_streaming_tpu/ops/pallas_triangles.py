@@ -107,14 +107,49 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def pane_triangles_dense(u: np.ndarray, v: np.ndarray, num_vertices: int) -> int:
-    """Count triangles among canonical (u < v) deduped edges via the kernel.
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _count_from_edges(u, v, mask, k: int, interpret: bool):
+    """Device-side pane count: scatter the (possibly duplicated, uncanonical)
+    edge list into a dense [k, k] adjacency and run the MXU kernel.
 
-    Host wrapper: scatters the edge list into a padded dense adjacency and
-    invokes the MXU kernel.  ``num_vertices`` is the compacted vertex count.
+    Building the adjacency on device keeps the host->device transfer at the
+    edge list's size (8 B/edge) instead of the k*k matrix (the dense pane
+    previously shipped 16 MB/pane through the tunnel — ~200 ms — vs ~1 ms for
+    the edges), and the scatter dedups duplicate edges for free.
+    """
+    ok = mask & (u != v)
+    uu = jnp.where(ok, u, 0)
+    vv = jnp.where(ok, v, 0)
+    adj = jnp.zeros((k, k), jnp.bool_)
+    adj = adj.at[uu, vv].max(ok)
+    adj = adj.at[vv, uu].max(ok)
+    return _count_halves(adj, interpret=interpret)
+
+
+def pane_triangles_dense(
+    u: np.ndarray, v: np.ndarray, num_vertices: int, mask=None
+) -> int:
+    """Count triangles among a pane's edges via the MXU kernel.
+
+    ``u``/``v`` may contain duplicates and both orientations (the device
+    scatter canonicalizes); self-loops are dropped.  ``num_vertices`` bounds
+    the ids.  The edge list is padded to the next power of two so varying pane
+    sizes reuse a bounded set of compiled kernels.
     """
     k = max(TILE, ((num_vertices + TILE - 1) // TILE) * TILE)
-    adj = np.zeros((k, k), np.bool_)
-    adj[u, v] = True
-    adj[v, u] = True
-    return triangle_count_dense(jnp.asarray(adj), interpret=_use_interpret())
+    if k > MAX_K:
+        raise ValueError(f"K={k} exceeds the kernel's exactness bound {MAX_K}")
+    n = len(u)
+    if n == 0:
+        return 0
+    cap = max(1, 1 << (n - 1).bit_length())
+    uu = np.zeros((cap,), np.int32)
+    vv = np.zeros((cap,), np.int32)
+    mm = np.zeros((cap,), bool)
+    uu[:n] = u
+    vv[:n] = v
+    mm[:n] = True if mask is None else mask
+    halves = np.asarray(
+        _count_from_edges(uu, vv, mm, k, _use_interpret())
+    ).astype(np.int64)
+    return int((halves[0, 0] + (halves[0, 1] << _LO_BITS)) // 6)

@@ -1,0 +1,127 @@
+"""The packed-wire aggregate fast path must match the simulated runtime.
+
+VERDICT r1 item 2: the product API (EdgeStream.aggregate) rides the packed-wire
++ prefetch ingest (io/wire.py) whenever the source exposes wire arrays.  These
+tests pin (a) eligibility gating, (b) result equivalence against the simulated
+pane path on CC and bipartiteness, and (c) stage composition (stages run in-jit
+after the device-side unpack).
+"""
+
+import numpy as np
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.bipartiteness import BipartitenessCheck
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+
+
+def _random_edges(n=4000, c=64, seed=7):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, c, n).astype(np.int32),
+        rng.integers(0, c, n).astype(np.int32),
+    )
+
+
+def test_from_arrays_is_wire_eligible():
+    src, dst = _random_edges()
+    cfg = StreamConfig(vertex_capacity=64, batch_size=256)
+    stream = EdgeStream.from_arrays(src, dst, cfg)
+    agg = ConnectedComponents()
+    assert agg._wire_eligible(stream, checkpoint_path=None)
+    assert not agg._wire_eligible(stream, checkpoint_path="/tmp/x")
+    sharded = StreamConfig(vertex_capacity=64, batch_size=256, num_shards=2)
+    assert not agg._wire_eligible(
+        EdgeStream.from_arrays(src, dst, sharded), checkpoint_path=None
+    )
+    # collection sources have no wire arrays -> simulated path
+    coll = EdgeStream.from_collection([(0, 1)], cfg)
+    assert not agg._wire_eligible(coll, checkpoint_path=None)
+
+
+def test_wire_cc_matches_simulated():
+    src, dst = _random_edges()
+    cfg = StreamConfig(vertex_capacity=64, batch_size=256)
+    fast = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    slow = (
+        EdgeStream.from_collection(list(zip(src.tolist(), dst.tolist())), cfg, 256)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert len(fast) == len(slow) == 1
+    assert fast[0][0].components() == slow[0][0].components()
+
+
+def test_wire_cc_with_stages_matches_simulated():
+    src, dst = _random_edges(n=1000, c=32)
+    cfg = StreamConfig(vertex_capacity=32, max_degree=40, batch_size=128)
+
+    def pipeline(stream):
+        return (
+            stream.filter_edges(lambda s, d, v: s != d)
+            .undirected()
+            .distinct()
+            .aggregate(ConnectedComponents())
+            .collect()
+        )
+
+    fast = pipeline(EdgeStream.from_arrays(src, dst, cfg))
+    slow = pipeline(
+        EdgeStream.from_collection(list(zip(src.tolist(), dst.tolist())), cfg, 128)
+    )
+    assert fast[0][0].components() == slow[0][0].components()
+
+
+def test_wire_bipartiteness_matches_simulated():
+    # an odd cycle makes it non-bipartite; also check the bipartite case
+    for edges in ([(0, 1), (1, 2), (2, 0)], [(0, 1), (1, 2), (2, 3)]):
+        src = np.array([e[0] for e in edges], np.int32)
+        dst = np.array([e[1] for e in edges], np.int32)
+        cfg = StreamConfig(vertex_capacity=8, batch_size=4)
+        fast = (
+            EdgeStream.from_arrays(src, dst, cfg)
+            .aggregate(BipartitenessCheck())
+            .collect()
+        )
+        slow = (
+            EdgeStream.from_collection(edges, cfg, 4)
+            .aggregate(BipartitenessCheck())
+            .collect()
+        )
+        assert str(fast[-1][0]) == str(slow[-1][0])
+
+
+def test_wire_partial_tail_batch():
+    # 1000 edges with batch 256 leaves a 232-edge tail: the padded tail step
+    # must fold it with correct masking
+    src, dst = _random_edges(n=1000, c=64)
+    cfg = StreamConfig(vertex_capacity=64, batch_size=256)
+    fast = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    slow = (
+        EdgeStream.from_collection(list(zip(src.tolist(), dst.tolist())), cfg, 256)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert fast[0][0].components() == slow[0][0].components()
+
+
+def test_wire_path_repeat_run_reuses_cache():
+    # OutputStream is re-runnable; the second run must produce the same result
+    # (fresh state) and hit the compiled-step cache
+    src, dst = _random_edges(n=512, c=64)
+    cfg = StreamConfig(vertex_capacity=64, batch_size=128)
+    agg = ConnectedComponents()
+    out = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg)
+    first = out.collect()
+    assert len(agg._wire_step_cache) == 1
+    second = out.collect()
+    assert len(agg._wire_step_cache) == 1
+    assert first[0][0].components() == second[0][0].components()
