@@ -143,6 +143,8 @@ class SummaryAggregation:
             jax.jit(fused, donate_argnums=0),
             jax.jit(tail, donate_argnums=0),
         )
+        while len(cache) >= 8:  # bound: evict oldest (compiled fns are heavy)
+            cache.pop(next(iter(cache)))
         cache[key] = entry
         return entry
 
@@ -154,9 +156,13 @@ class SummaryAggregation:
         batch = min(batch, max(len(src), 1))
         width = wire.width_for_capacity(cfg.vertex_capacity)
         fused, tail = self._wire_fused_step(stream, batch, width)
-        carry = (
-            tuple(stage.init(cfg) for stage in stream._stages),
-            self.initial_state(cfg),
+        # committed placement so the first and later calls share one jit entry
+        carry = jax.device_put(
+            (
+                tuple(stage.init(cfg) for stage in stream._stages),
+                self.initial_state(cfg),
+            ),
+            jax.devices()[0],
         )
         n_full = len(src) // batch
 

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gelly_streaming_tpu.core.config import StreamConfig
-from gelly_streaming_tpu.core.output import NULL, OutputStream
+from gelly_streaming_tpu.core.output import NULL, OutputStream, RecordBlock
 from gelly_streaming_tpu.core.types import EdgeBatch, EdgeDirection
 from gelly_streaming_tpu.ops import neighbors, segments
 
@@ -158,8 +158,8 @@ class EdgeStream:
         the product-API equivalent of the reference's runtime-internal network
         ingest (SummaryBulkAggregation.java:76-83 runs *inside* Flink's stack).
         """
-        src = np.ascontiguousarray(src, dtype=np.int32)
-        dst = np.ascontiguousarray(dst, dtype=np.int32)
+        src = np.asarray(src)
+        dst = np.asarray(dst)
         if src.shape != dst.shape:
             raise ValueError("src/dst length mismatch")
         if len(src) and (
@@ -167,12 +167,15 @@ class EdgeStream:
             or max(src.max(), dst.max()) >= cfg.vertex_capacity
         ):
             # Out-of-range ids would silently wrap on the packed wire (and
-            # clamp in device scatters) — fail loudly; intern first
-            # (io/interning.py is the framework's bounds guard).
+            # clamp in device scatters) — fail loudly BEFORE the int32 cast
+            # (a cast-first check would let 64-bit ids wrap into range);
+            # intern first (io/interning.py is the framework's bounds guard).
             raise ValueError(
                 "vertex ids must be in [0, vertex_capacity); intern ids first "
                 "(io.interning.VertexInterner)"
             )
+        src = np.ascontiguousarray(src, dtype=np.int32)
+        dst = np.ascontiguousarray(dst, dtype=np.int32)
         bs = batch_size or cfg.batch_size
 
         def factory():
@@ -275,6 +278,104 @@ class EdgeStream:
             states, out = step(states, batch)
             yield out
 
+    def _kernel_stream(self, init_fn, kernel) -> Iterator:
+        """Run a terminal op's kernel fused with the pipeline stages.
+
+        ``kernel(op_state, EdgeBatch) -> (op_state, outs)`` with ``outs`` a
+        pytree of per-batch output arrays; ``init_fn(cfg)`` builds the op
+        state.  Yields ``outs`` (device arrays) per micro-batch.  When the
+        source is wire-backed the whole step — device-side unpack, stages,
+        kernel — is ONE jitted function fed by prefetched packed transfers
+        with the carry donated (the property-stream analog of the aggregate
+        fast path); otherwise it runs over the EdgeBatch source.
+        """
+        cfg = self.cfg
+        stages = self._stages
+        step_j, wire_j = self._kernel_step_jits(kernel)
+
+        # Committed placement: without it the first call (uncommitted fresh
+        # arrays) and later calls (committed step outputs) hit different jit
+        # cache entries — paying the compile twice.
+        carry = jax.device_put(
+            (tuple(stage.init(cfg) for stage in stages), init_fn(cfg)),
+            jax.devices()[0],
+        )
+
+        if self._wire_arrays is None:
+            for batch in self._source_factory():
+                carry, outs = step_j(carry, batch)
+                yield outs
+            return
+
+        from gelly_streaming_tpu.io import wire
+
+        src, dst, batch_size = self._wire_arrays
+        bs = min(batch_size, max(len(src), 1))
+        n_full = len(src) // bs
+
+        def full_batches():
+            for i in range(n_full):
+                yield src[i * bs : (i + 1) * bs], dst[i * bs : (i + 1) * bs]
+
+        width = wire.width_for_capacity(cfg.vertex_capacity)
+        with wire.WirePrefetcher(
+            full_batches(), width, depth=cfg.prefetch_depth
+        ) as pf:
+            for buf, _ in pf:
+                carry, outs = wire_j(carry, buf, bs, width)
+                yield outs
+        rem = len(src) - n_full * bs
+        if rem:
+            tail = EdgeBatch.from_arrays(
+                src[n_full * bs :], dst[n_full * bs :], pad_to=bs
+            )
+            carry, outs = step_j(carry, tail)
+            yield outs
+
+    def _kernel_step_jits(self, kernel):
+        """Jitted (plain, wire) step functions for a terminal-op kernel.
+
+        Cached per kernel object (one per OutputStream) so re-consuming an
+        OutputStream reuses compiled executables instead of recompiling
+        (seconds per run on TPU).  The cache is bounded: entries beyond the
+        cap evict oldest-first.
+        """
+        cache = getattr(self, "_kstream_cache", None)
+        if cache is None:
+            cache = self._kstream_cache = {}
+        if kernel in cache:
+            return cache[kernel]
+        from gelly_streaming_tpu.io import wire
+
+        stages = self._stages
+
+        def step(carry, batch):
+            states, op_state = carry
+            out_states = []
+            for stage, st in zip(stages, states):
+                st, batch = stage.apply(st, batch)
+                out_states.append(st)
+            op_state, outs = kernel(op_state, batch)
+            return (tuple(out_states), op_state), outs
+
+        def wire_step(carry, buf, bs, width):
+            s, d = wire.unpack_edges(buf, bs, width)
+            # keep the byte-unpack expression out of downstream gather/scatter
+            # fusions (see _interleave_endpoints: ~7x TPU compile blowup)
+            s, d = jax.lax.optimization_barrier((s, d))
+            return step(
+                carry, EdgeBatch(src=s, dst=d, mask=jnp.ones((bs,), bool))
+            )
+
+        entry = (
+            jax.jit(step),
+            jax.jit(wire_step, static_argnums=(2, 3), donate_argnums=0),
+        )
+        while len(cache) >= 8:
+            cache.pop(next(iter(cache)))
+        cache[kernel] = entry
+        return entry
+
     def collect_edges(self) -> List[tuple]:
         out: List[tuple] = []
         for b in self.batches():
@@ -289,24 +390,22 @@ class EdgeStream:
     def get_vertices(self) -> OutputStream:
         """(vertex, NullValue) on each vertex's first appearance
         (SimpleEdgeStream.java:116-129: EmitSrcAndTarget + FilterDistinctVertices)."""
-        cfg = self.cfg
+
+        def init(cfg):
+            return jnp.zeros((cfg.vertex_capacity,), bool)
 
         def kernel(seen, batch):
             v, m = _interleave_endpoints(batch)
             new = segments.first_occurrence_mask(v, m) & ~seen[v] & m
             seen = seen.at[jnp.where(m, v, 0)].max(m)
-            return seen, v, new
+            return seen, (v, new)
 
-        kernel = jax.jit(kernel)
+        def blocks():
+            for v, new in self._kernel_stream(init, kernel):
+                idx = np.nonzero(np.asarray(new))[0]
+                yield RecordBlock((np.asarray(v)[idx], NULL))
 
-        def records():
-            seen = jnp.zeros((cfg.vertex_capacity,), bool)
-            for batch in self.batches():
-                seen, v, new = kernel(seen, batch)
-                for vertex in np.asarray(v)[np.asarray(new)]:
-                    yield (int(vertex), NULL)
-
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)
 
     def get_degrees(self) -> OutputStream:
         """Running (vertex, degree) trace over both endpoints
@@ -326,7 +425,9 @@ class EdgeStream:
         update (SimpleEdgeStream.java:461-478): the k-th in-batch occurrence of
         vertex v emits ``base[v] + k + 1`` and a segment add bumps the base.
         """
-        cfg = self.cfg
+
+        def init(cfg):
+            return jnp.zeros((cfg.vertex_capacity,), jnp.int32)
 
         def kernel(counts, batch):
             if direction == EdgeDirection.ALL:
@@ -338,25 +439,24 @@ class EdgeStream:
             rank = segments.occurrence_rank(v, m)
             emitted = counts[v] + rank + 1
             counts = counts.at[jnp.where(m, v, 0)].add(m.astype(jnp.int32))
-            return counts, v, emitted, m
+            return counts, (v, emitted, m)
 
-        kernel = jax.jit(kernel)
+        def blocks():
+            for v, emitted, m in self._kernel_stream(init, kernel):
+                idx = np.nonzero(np.asarray(m))[0]
+                yield RecordBlock(
+                    (np.asarray(v)[idx], np.asarray(emitted)[idx])
+                )
 
-        def records():
-            counts = jnp.zeros((cfg.vertex_capacity,), jnp.int32)
-            for batch in self.batches():
-                counts, v, emitted, m = kernel(counts, batch)
-                v_h, e_h, m_h = np.asarray(v), np.asarray(emitted), np.asarray(m)
-                for i in np.nonzero(m_h)[0]:
-                    yield (int(v_h[i]), int(e_h[i]))
-
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)
 
     def number_of_vertices(self) -> OutputStream:
         """Running distinct-vertex count, emitted on change
         (SimpleEdgeStream.java:366-383 via globalAggregate's change-dedup
         GlobalAggregateMapper :562-576)."""
-        cfg = self.cfg
+
+        def init(cfg):
+            return jnp.zeros((cfg.vertex_capacity,), bool)
 
         def kernel(seen, batch):
             v, m = _interleave_endpoints(batch)
@@ -364,39 +464,32 @@ class EdgeStream:
             base = jnp.sum(seen.astype(jnp.int32))
             running = base + jnp.cumsum(new.astype(jnp.int32))
             seen = seen.at[jnp.where(m, v, 0)].max(m)
-            return seen, running, new
+            return seen, (running, new)
 
-        kernel = jax.jit(kernel)
+        def blocks():
+            for running, new in self._kernel_stream(init, kernel):
+                idx = np.nonzero(np.asarray(new))[0]
+                yield RecordBlock((np.asarray(running)[idx],))
 
-        def records():
-            seen = jnp.zeros((cfg.vertex_capacity,), bool)
-            for batch in self.batches():
-                seen, running, new = kernel(seen, batch)
-                r_h = np.asarray(running)
-                for i in np.nonzero(np.asarray(new))[0]:
-                    yield (int(r_h[i]),)
-
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)
 
     def number_of_edges(self) -> OutputStream:
         """Running edge count, one record per arriving edge
         (parallelism-1 counter, SimpleEdgeStream.java:388-404)."""
 
+        def init(cfg):
+            return jnp.zeros((), jnp.int32)
+
         def kernel(total, batch):
             running = total + jnp.cumsum(batch.mask.astype(jnp.int32))
-            return total + batch.num_valid(), running
+            return total + batch.num_valid(), (running, batch.mask)
 
-        kernel = jax.jit(kernel)
+        def blocks():
+            for running, m in self._kernel_stream(init, kernel):
+                idx = np.nonzero(np.asarray(m))[0]
+                yield RecordBlock((np.asarray(running)[idx],))
 
-        def records():
-            total = jnp.zeros((), jnp.int32)
-            for batch in self.batches():
-                total, running = kernel(total, batch)
-                r_h = np.asarray(running)
-                for i in np.nonzero(np.asarray(batch.mask))[0]:
-                    yield (int(r_h[i]),)
-
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)
 
     def get_edges(self) -> OutputStream:
         """The edge stream itself as records (GraphStream.getEdges)."""
@@ -549,10 +642,15 @@ class EdgeStream:
 def _interleave_endpoints(batch: EdgeBatch) -> Tuple[jax.Array, jax.Array]:
     """Per-edge (src, dst) emission order, flattened to [2B]
     (mirrors EmitSrcAndTarget / DegreeTypeSeparator emission order,
-    SimpleEdgeStream.java:181-188,450-458)."""
+    SimpleEdgeStream.java:181-188,450-458).
+
+    The barrier stops XLA from inlining the stack/reshape expression into
+    every downstream gather/scatter — without it the TPU compile of a
+    sort+gather+scatter consumer at 2^21 rows blows up ~7x (173s vs 24s
+    measured on v5e via remote compile)."""
     v = jnp.stack([batch.src, batch.dst], axis=1).reshape(-1)
     m = jnp.stack([batch.mask, batch.mask], axis=1).reshape(-1)
-    return v, m
+    return jax.lax.optimization_barrier(v), m
 
 
 def _round_robin(iterators: List[Iterator]) -> Iterator:

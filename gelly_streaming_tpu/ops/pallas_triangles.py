@@ -87,6 +87,17 @@ def _count_halves(adj: jax.Array, *, interpret: bool = False) -> jax.Array:
     )(a, a, a)
 
 
+def _triangles_from_halves(halves) -> int:
+    """Recombine the kernel's low/high running totals into the count."""
+    halves = np.asarray(halves).astype(np.int64)
+    return int((halves[0, 0] + (halves[0, 1] << _LO_BITS)) // 6)
+
+
+def _check_k(k: int) -> None:
+    if k > MAX_K:
+        raise ValueError(f"K={k} exceeds the kernel's exactness bound {MAX_K}")
+
+
 def triangle_count_dense(adj, *, interpret: bool = False) -> int:
     """Exact triangle count of a dense 0/1 adjacency matrix (zero diagonal).
 
@@ -96,10 +107,8 @@ def triangle_count_dense(adj, *, interpret: bool = False) -> int:
     k = adj.shape[0]
     if adj.shape != (k, k) or k % TILE != 0:
         raise ValueError(f"adjacency must be square with K % {TILE} == 0, got {adj.shape}")
-    if k > MAX_K:
-        raise ValueError(f"K={k} exceeds the kernel's exactness bound {MAX_K}")
-    halves = np.asarray(_count_halves(adj, interpret=interpret)).astype(np.int64)
-    return int((halves[0, 0] + (halves[0, 1] << _LO_BITS)) // 6)
+    _check_k(k)
+    return _triangles_from_halves(_count_halves(adj, interpret=interpret))
 
 
 def _use_interpret() -> bool:
@@ -137,8 +146,7 @@ def pane_triangles_dense(
     sizes reuse a bounded set of compiled kernels.
     """
     k = max(TILE, ((num_vertices + TILE - 1) // TILE) * TILE)
-    if k > MAX_K:
-        raise ValueError(f"K={k} exceeds the kernel's exactness bound {MAX_K}")
+    _check_k(k)
     n = len(u)
     if n == 0:
         return 0
@@ -149,7 +157,6 @@ def pane_triangles_dense(
     uu[:n] = u
     vv[:n] = v
     mm[:n] = True if mask is None else mask
-    halves = np.asarray(
+    return _triangles_from_halves(
         _count_from_edges(uu, vv, mm, k, _use_interpret())
-    ).astype(np.int64)
-    return int((halves[0, 0] + (halves[0, 1] << _LO_BITS)) // 6)
+    )

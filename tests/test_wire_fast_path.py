@@ -125,3 +125,16 @@ def test_wire_path_repeat_run_reuses_cache():
     second = out.collect()
     assert len(agg._wire_step_cache) == 1
     assert first[0][0].components() == second[0][0].components()
+
+
+def test_from_arrays_rejects_out_of_range_ids():
+    import pytest
+
+    cfg = StreamConfig(vertex_capacity=1 << 16)
+    with pytest.raises(ValueError):
+        EdgeStream.from_arrays(np.array([70000]), np.array([1]), cfg)
+    # 64-bit ids that would wrap into range after an int32 cast must still fail
+    with pytest.raises(ValueError):
+        EdgeStream.from_arrays(
+            np.array([2**32 + 5], np.int64), np.array([7], np.int64), cfg
+        )
