@@ -66,18 +66,23 @@ class SummaryAggregation:
     def _num_partitions(self, cfg: StreamConfig) -> int:
         return cfg.num_shards
 
-    def _fold_partials(self, items, combine2):
+    def _fold_partials(self, items, combine2, fanin: int = 2):
         """Combine-strategy hook over opaque items: flat left fold
         (timeWindowAll.reduce analog, SummaryBulkAggregation.java:81-83).
-        Overridden by the tree strategy.  Shared by the simulated runtime and
-        the mesh runner so the strategies cannot diverge."""
+        Overridden by the tree strategy (which consumes ``fanin``).  Shared by
+        the simulated runtime and the mesh runner so the strategies cannot
+        diverge."""
         acc = items[0]
         for it in items[1:]:
             acc = combine2(acc, it)
         return acc
 
-    def _combine_partials(self, partials):
-        return self._fold_partials(partials, self._combine_j)
+    def _tree_fanin(self, cfg: StreamConfig) -> int:
+        """Combine-tree fan-in (SummaryTreeReduce's ``degree``, :53-64)."""
+        return max(2, cfg.tree_degree)
+
+    def _combine_partials(self, partials, cfg: StreamConfig):
+        return self._fold_partials(partials, self._combine_j, self._tree_fanin(cfg))
 
     @property
     def _update_j(self):
@@ -227,111 +232,155 @@ class SummaryAggregation:
         emissions after the last snapshot are re-emitted (at-least-once), as
         in the reference's Merger.  The untimed single global pane resumes
         only for an unchanged replay (it has no sub-pane position — a longer
-        replayed stream's extra untimed edges would be skipped with it)."""
+        replayed stream's extra untimed edges would be skipped with it).
+
+        Execution strategy by config (the reference picks its pipeline at
+        graph-build time the same way): wire-backed single-shard streams ride
+        the packed-wire fast path; ``cfg.num_shards > 1`` with enough devices
+        runs the real sharded data plane (MeshAggregationRunner); otherwise
+        partitions are simulated sequentially (the MiniCluster shape).  All
+        paths share the Merger/checkpoint loop (`_merge_loop`)."""
         if self._wire_eligible(stream, checkpoint_path):
             return OutputStream(lambda: self._wire_records(stream))
         cfg = stream.cfg
+        if cfg.num_shards > 1 and cfg.num_shards <= len(jax.devices()):
+            return self._mesh_runner(cfg).run(
+                stream, checkpoint_path=checkpoint_path, restore=restore
+            )
         window_ms = self.window_ms or cfg.window_ms
         n_parts = self._num_partitions(cfg)
 
-        def records() -> Iterator[tuple]:
-            running = None
-            start_after = -1
-            global_done = False
-            if checkpoint_path and restore:
-                from gelly_streaming_tpu.utils.checkpoint import (
-                    checkpoint_exists,
-                    load_state,
-                )
-
-                if checkpoint_exists(checkpoint_path):
-                    try:
-                        snap = load_state(
-                            checkpoint_path, self._checkpoint_like(cfg)
-                        )
-                        if bool(snap["has_summary"]):
-                            running = snap["summary"]
-                        start_after = int(snap["last_window"])
-                        global_done = bool(snap["global_done"])
-                    except ValueError:
-                        # legacy snapshot layout: a bare summary pytree with
-                        # no stream position (pre-position checkpoints)
-                        running = load_state(
-                            checkpoint_path, self.initial_state(cfg)
-                        )
-            for pane in assign_tumbling_windows(stream.batches(), window_ms):
-                already_folded = (0 <= pane.window_id <= start_after) or (
-                    pane.window_id == -1 and global_done
-                )
-                if already_folded:
-                    continue  # folded before the snapshot: replay-safe
-                partials = []
-                for part in range(n_parts):
-                    # Round-robin partitioning of the pane stands in for the
-                    # reference's source-subtask tagging (PartitionMapper,
-                    # SummaryBulkAggregation.java:93-106).
-                    sel = np.arange(len(pane.src)) % n_parts == part
-                    if not sel.any():
-                        continue
-                    # Pad to the next power of two so varying pane sizes hit a
-                    # small, bounded set of compiled kernel shapes.
-                    n = int(sel.sum())
-                    padded = max(1, 1 << (n - 1).bit_length())
-                    mask = np.zeros((padded,), bool)
-                    mask[:n] = True
-
-                    def pad(a, fill=0):
-                        out = np.full((padded,) + a.shape[1:], fill, a.dtype)
-                        out[:n] = a[sel]
-                        return out
-
-                    state = self.initial_state(cfg)
-                    state = self._update_j(
-                        state,
-                        jnp.asarray(pad(pane.src), jnp.int32),
-                        jnp.asarray(pad(pane.dst), jnp.int32),
-                        None
-                        if pane.val is None
-                        else jax.tree.map(lambda a: jnp.asarray(pad(a)), pane.val),
-                        jnp.asarray(mask),
-                    )
-                    partials.append(state)
-                if not partials:
+        def fold_pane(pane: WindowPane):
+            partials = []
+            for part in range(n_parts):
+                # Round-robin partitioning of the pane stands in for the
+                # reference's source-subtask tagging (PartitionMapper,
+                # SummaryBulkAggregation.java:93-106).
+                sel = np.arange(len(pane.src)) % n_parts == part
+                if not sel.any():
                     continue
-                pane_summary = self._combine_partials(partials)
-                # Merger: non-blocking running merge, one emission per window
-                # close (SummaryAggregation.java:107-119).
-                if running is None or self.transient_state:
-                    running = pane_summary
-                else:
-                    running = self._combine_j(running, pane_summary)
-                out = self.transform(running)
-                # Emit BEFORE snapshotting: a crash between the two re-emits
-                # this window on recovery (at-least-once emission) instead of
-                # dropping it (at-most-once would lose sink data).
-                yield out if isinstance(out, tuple) else (out,)
-                start_after = max(pane.window_id, start_after)
-                global_done = global_done or pane.window_id == -1
-                if checkpoint_path:
-                    from gelly_streaming_tpu.utils.checkpoint import save_state
+                # Pad to the next power of two so varying pane sizes hit a
+                # small, bounded set of compiled kernel shapes.
+                n = int(sel.sum())
+                padded = max(1, 1 << (n - 1).bit_length())
+                mask = np.zeros((padded,), bool)
+                mask[:n] = True
 
-                    # transient aggregations reset after emission, so a
-                    # restore must come back with no running summary
-                    save_state(
-                        checkpoint_path,
-                        {
-                            "summary": running,
-                            "has_summary": np.full(
-                                (), not self.transient_state, bool
-                            ),
-                            "last_window": np.full((), start_after, np.int64),
-                            "global_done": np.full((), global_done, bool),
-                        },
-                    )
-                if self.transient_state:
-                    running = None
+                def pad(a, fill=0):
+                    out = np.full((padded,) + a.shape[1:], fill, a.dtype)
+                    out[:n] = a[sel]
+                    return out
+
+                state = self.initial_state(cfg)
+                state = self._update_j(
+                    state,
+                    jnp.asarray(pad(pane.src), jnp.int32),
+                    jnp.asarray(pad(pane.dst), jnp.int32),
+                    None
+                    if pane.val is None
+                    else jax.tree.map(lambda a: jnp.asarray(pad(a)), pane.val),
+                    jnp.asarray(mask),
+                )
+                partials.append(state)
+            if not partials:
+                return None
+            return self._combine_partials(partials, cfg)
+
+        def records() -> Iterator[tuple]:
+            return self._merge_loop(
+                cfg,
+                assign_tumbling_windows(stream.batches(), window_ms),
+                fold_pane,
+                checkpoint_path,
+                restore,
+            )
 
         return OutputStream(records)
+
+    def _mesh_runner(self, cfg: StreamConfig) -> "MeshAggregationRunner":
+        """Cached sharded runner for cfg.num_shards (compiled steps persist)."""
+        runner = getattr(self, "_mesh_runner_cache", None)
+        if runner is None or runner.num_shards != cfg.num_shards:
+            from gelly_streaming_tpu.parallel.mesh import make_mesh
+
+            runner = MeshAggregationRunner(self, mesh=make_mesh(cfg.num_shards))
+            self._mesh_runner_cache = runner
+        return runner
+
+    def _merge_loop(
+        self,
+        cfg: StreamConfig,
+        panes: Iterator[WindowPane],
+        fold_pane: Callable,
+        checkpoint_path: Optional[str],
+        restore: bool,
+    ) -> Iterator[tuple]:
+        """The Merger: running merge + emission + positional checkpointing
+        (SummaryAggregation.java:93-135), shared by the simulated and mesh
+        execution paths so their recovery semantics cannot diverge.
+
+        ``fold_pane(pane) -> summary | None`` supplies the per-pane partial
+        fold+combine; everything downstream (merge order, transient reset,
+        at-least-once emission, snapshot layout) is common.
+        """
+        running = None
+        start_after = -1
+        global_done = False
+        if checkpoint_path and restore:
+            from gelly_streaming_tpu.utils.checkpoint import (
+                checkpoint_exists,
+                load_state,
+            )
+
+            if checkpoint_exists(checkpoint_path):
+                try:
+                    snap = load_state(checkpoint_path, self._checkpoint_like(cfg))
+                    if bool(snap["has_summary"]):
+                        running = snap["summary"]
+                    start_after = int(snap["last_window"])
+                    global_done = bool(snap["global_done"])
+                except ValueError:
+                    # legacy snapshot layout: a bare summary pytree with
+                    # no stream position (pre-position checkpoints)
+                    running = load_state(checkpoint_path, self.initial_state(cfg))
+        for pane in panes:
+            already_folded = (0 <= pane.window_id <= start_after) or (
+                pane.window_id == -1 and global_done
+            )
+            if already_folded:
+                continue  # folded before the snapshot: replay-safe
+            pane_summary = fold_pane(pane)
+            if pane_summary is None:
+                continue
+            # Merger: non-blocking running merge, one emission per window
+            # close (SummaryAggregation.java:107-119).
+            if running is None or self.transient_state:
+                running = pane_summary
+            else:
+                running = self._combine_j(running, pane_summary)
+            out = self.transform(running)
+            # Emit BEFORE snapshotting: a crash between the two re-emits
+            # this window on recovery (at-least-once emission) instead of
+            # dropping it (at-most-once would lose sink data).
+            yield out if isinstance(out, tuple) else (out,)
+            start_after = max(pane.window_id, start_after)
+            global_done = global_done or pane.window_id == -1
+            if checkpoint_path:
+                from gelly_streaming_tpu.utils.checkpoint import save_state
+
+                # transient aggregations reset after emission, so a
+                # restore must come back with no running summary
+                save_state(
+                    checkpoint_path,
+                    {
+                        "summary": running,
+                        "has_summary": np.full((), not self.transient_state, bool),
+                        "last_window": np.full((), start_after, np.int64),
+                        "global_done": np.full((), global_done, bool),
+                    },
+                )
+            if self.transient_state:
+                running = None
 
 
 class SummaryBulkAggregation(SummaryAggregation):
@@ -339,18 +388,33 @@ class SummaryBulkAggregation(SummaryAggregation):
 
 
 class SummaryTreeAggregation(SummaryAggregation):
-    """Log-depth pairwise combine (SummaryTreeReduce.java:47-123): partials are
-    merged in halving rounds (key = partition/2) instead of one flat fold —
-    same fixed point for associative combines, fewer sequential merge steps."""
+    """Log-depth combine tree (SummaryTreeReduce.java:47-123): partials merge
+    in rounds of ``degree``-ary groups (the reference re-keys partitions by
+    ``partition/2`` per level and exposes a configurable ``degree`` :53-64,
+    defaulting to the stream parallelism :75) — same fixed point as the flat
+    fold for associative combines, fewer sequential merge steps.
 
-    def _fold_partials(self, items, combine2):
+    ``degree`` here defaults to ``cfg.tree_degree``; pass it explicitly to
+    mirror the reference's constructor knob.
+    """
+
+    def __init__(self, window_ms: Optional[int] = None, degree: Optional[int] = None):
+        super().__init__(window_ms)
+        self.degree = degree
+
+    def _tree_fanin(self, cfg: StreamConfig) -> int:
+        return max(2, self.degree or cfg.tree_degree)
+
+    def _fold_partials(self, items, combine2, fanin: int = 2):
         level = list(items)
         while len(level) > 1:
             nxt = []
-            for i in range(0, len(level) - 1, 2):
-                nxt.append(combine2(level[i], level[i + 1]))
-            if len(level) % 2:
-                nxt.append(level[-1])
+            for i in range(0, len(level), fanin):
+                group = level[i : i + fanin]
+                acc = group[0]
+                for it in group[1:]:
+                    acc = combine2(acc, it)
+                nxt.append(acc)
             level = nxt
         return level[0]
 
@@ -392,7 +456,8 @@ class MeshAggregationRunner:
 
     def _pane_step(self, cfg: StreamConfig, cap: int, has_val: bool):
         """Compiled sharded fold+combine for panes bucketed at capacity cap."""
-        key = (cfg, cap, has_val)
+        # fan-in is baked into the compiled combine tree -> part of the key
+        key = (cfg, cap, has_val, self.agg._tree_fanin(cfg))
         if key in self._step_cache:
             return self._step_cache[key]
         from jax.sharding import PartitionSpec as P
@@ -433,7 +498,9 @@ class MeshAggregationRunner:
                 (jax.tree.map(lambda g: g[i], gathered), has_data[i])
                 for i in range(n)
             ]
-            acc, _ = agg._fold_partials(parts, masked_combine)
+            acc, _ = agg._fold_partials(
+                parts, masked_combine, agg._tree_fanin(cfg)
+            )
             return acc
 
         spec = P(self._axis)
@@ -478,33 +545,45 @@ class MeshAggregationRunner:
                 val = jax.tree.map(fill, val, pane.val)
         return src, dst, val, mask
 
-    def run(self, stream, window_ms: Optional[int] = None) -> OutputStream:
-        """(transform(running_summary),) per closed window, like run()."""
+    def run(
+        self,
+        stream,
+        window_ms: Optional[int] = None,
+        checkpoint_path: Optional[str] = None,
+        restore: bool = True,
+    ) -> OutputStream:
+        """(transform(running_summary),) per closed window, like run().
+
+        Shares the Merger/checkpoint loop with the simulated runtime
+        (`SummaryAggregation._merge_loop`), so positional checkpoints and
+        kill-and-resume work identically on the sharded data plane — the
+        distributed analog of the reference's ListCheckpointed Merger
+        (SummaryAggregation.java:127-135).
+        """
         cfg = stream.cfg
         window_ms = window_ms or self.agg.window_ms or cfg.window_ms
         agg = self.agg
 
+        def fold_pane(pane: WindowPane):
+            if len(pane.src) == 0:
+                return None
+            src, dst, val, mask = self._bucket_pane(pane)
+            step = self._pane_step(cfg, src.shape[1], val is not None)
+            return step(
+                jnp.asarray(src),
+                jnp.asarray(dst),
+                None if val is None else jax.tree.map(jnp.asarray, val),
+                jnp.asarray(mask),
+            )
+
         def records() -> Iterator[tuple]:
-            running = None
-            for pane in assign_tumbling_windows(stream.batches(), window_ms):
-                if len(pane.src) == 0:
-                    continue
-                src, dst, val, mask = self._bucket_pane(pane)
-                step = self._pane_step(cfg, src.shape[1], val is not None)
-                pane_summary = step(
-                    jnp.asarray(src),
-                    jnp.asarray(dst),
-                    None if val is None else jax.tree.map(jnp.asarray, val),
-                    jnp.asarray(mask),
-                )
-                if running is None or agg.transient_state:
-                    running = pane_summary
-                else:
-                    running = agg._combine_j(running, pane_summary)
-                out = agg.transform(running)
-                yield out if isinstance(out, tuple) else (out,)
-                if agg.transient_state:
-                    running = None
+            return agg._merge_loop(
+                cfg,
+                assign_tumbling_windows(stream.batches(), window_ms),
+                fold_pane,
+                checkpoint_path,
+                restore,
+            )
 
         return OutputStream(records)
 

@@ -60,12 +60,17 @@ class PaneAssembler:
     diverge between the paths.
     """
 
-    def __init__(self, window_ms: int):
+    def __init__(self, window_ms: int, val_proto=None, has_time: bool = False):
+        """``val_proto``/``has_time`` declare the stream's record structure up
+        front (a pytree of zero-length arrays).  Pass them in multi-host runs:
+        with inference only, a host closing an empty share before its first
+        val-carrying batch would return val=None while peers return zero-length
+        pytrees, breaking positional share pairing."""
         self.window_ms = window_ms
         self._open = {}  # window_id -> list of (src, dst, val, time)
-        # remembered stream structure so empty shares stay shape-compatible
-        self._val_proto = None  # pytree of zero-length arrays, or None
-        self._has_time = False
+        # declared or inferred stream structure for shape-compatible empties
+        self._val_proto = val_proto  # pytree of zero-length arrays, or None
+        self._has_time = has_time
 
     def _remember_structure(self, val, time) -> None:
         if val is not None and self._val_proto is None:

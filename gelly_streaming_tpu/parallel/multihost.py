@@ -229,15 +229,19 @@ def multihost_tumbling_windows(
     board: ProcessWatermarkBoard,
     timeout: Optional[float] = None,
     on_late: Callable[[int, int], None] = _default_on_late,
+    val_proto=None,
 ) -> Iterator[WindowPane]:
     """This host's share of each tumbling pane, closed on *global* agreement.
 
     Same pane assembly as core/windows.py:assign_tumbling_windows, but a pane
     [w*window_ms, (w+1)*window_ms) is yielded only once every host's watermark
     has passed w — the straggler-safe close.  All hosts yield shares (possibly
-    empty) of the same pane ids in the same order.
+    empty) of the same pane ids in the same order.  For value-carrying
+    streams pass ``val_proto`` (a pytree of zero-length arrays) so an empty
+    share closed before this host's first val batch stays shape-compatible
+    with peers' shares.
     """
-    panes = PaneAssembler(window_ms)
+    panes = PaneAssembler(window_ms, val_proto=val_proto, has_time=True)
     em = _GatedEmitter(panes)
     local_mark = -1  # this host's watermark: max pane id seen, never regressing
 
@@ -269,11 +273,47 @@ def multihost_tumbling_windows(
     yield from em.drain_through(board.global_max_pane())
 
 
+def _collective_with_deadline(fn: Callable, arg, timeout: Optional[float]):
+    """Run a (potentially hanging) collective with a wall-clock deadline.
+
+    A crashed peer leaves survivors blocked inside the allgather forever —
+    the transport has no side channel.  The call runs on a watchdog thread;
+    exceeding ``timeout`` raises TimeoutError on the caller so the survivor
+    fails fast (the blocked daemon thread is abandoned; the process is
+    expected to tear down / restart its distributed context on this error).
+    """
+    if timeout is None:
+        return fn(arg)
+    result: dict = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            result["value"] = fn(arg)
+        except BaseException as e:  # surfaced on the caller
+            result["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise TimeoutError(
+            f"watermark collective exceeded {timeout}s — peer host crashed "
+            "or wedged; tear down and restart the distributed context"
+        )
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
 def lockstep_tumbling_windows(
     batches: Iterator[EdgeBatch],
     window_ms: int,
     allgather: Callable[[int], np.ndarray],
     on_late: Callable[[int, int], None] = _default_on_late,
+    timeout: Optional[float] = None,
+    val_proto=None,
 ) -> Iterator[WindowPane]:
     """Collective-transport variant for real multi-process (DCN) runs.
 
@@ -285,16 +325,20 @@ def lockstep_tumbling_windows(
     flush then emits the same tail of pane ids on every host.
 
     Pass ``JaxWatermarkBoard().allgather`` in a jax.distributed job, or any
-    callable with allgather semantics (tests use a thread barrier).
+    callable with allgather semantics (tests use a thread barrier).  With a
+    ``timeout``, a round blocked on a crashed peer raises TimeoutError
+    instead of hanging the survivors (see _collective_with_deadline);
+    ``val_proto`` declares the stream's value structure as in
+    multihost_tumbling_windows.
     """
-    panes = PaneAssembler(window_ms)
+    panes = PaneAssembler(window_ms, val_proto=val_proto, has_time=True)
     em = _GatedEmitter(panes)
     local_mark = -1
     max_pane = -1  # running max of real pane ids seen anywhere
 
     def agree(mark: int):
         nonlocal max_pane
-        marks = allgather(mark)
+        marks = _collective_with_deadline(allgather, mark, timeout)
         real = marks[marks != END]
         if len(real):
             max_pane = max(max_pane, int(real.max()))

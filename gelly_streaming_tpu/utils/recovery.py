@@ -28,17 +28,34 @@ def run_supervised(
     max_restarts: int = 3,
     recoverable: Tuple[Type[BaseException], ...] = (Exception,),
     on_restart: Optional[Callable[[int, BaseException], None]] = None,
+    max_total_restarts="auto",
 ) -> Iterator[tuple]:
     """Iterate ``make_stream()``'s records, rebuilding the pipeline on failure.
 
     ``make_stream`` must build a FRESH record iterator each call — e.g.
     ``lambda: agg.run(make_source(), checkpoint_path=ckpt)`` where
     ``make_source()`` replays the input from the beginning; the aggregation's
-    restored stream position makes the replay safe.  After ``max_restarts``
-    consecutive failures the last exception propagates.  ``on_restart(attempt,
+    restored stream position makes the replay safe.  ``on_restart(attempt,
     exc)`` observes each recovery (metrics/logging hook).
+
+    Two budgets bound the restart loop (the Flink analog is the
+    failure-rate restart strategy):
+      * ``max_restarts`` — consecutive failures without progress; a restart
+        that yielded at least one record resets it (a stream advancing
+        between crashes is distinct from one wedged on the same failure);
+      * ``max_total_restarts`` — absolute cap across the whole run ("auto" =
+        ``10 * max_restarts``), so a pipeline that deterministically crashes
+        on window N+1 after re-emitting window N cannot restart forever.
+        Pass ``None`` for indefinitely-supervised streams (long-lived
+        pipelines where occasional transient failures over weeks are
+        expected and should never exhaust a budget).
     """
+    if max_total_restarts == "auto":
+        max_total_restarts = 10 * max_restarts
+    elif max_total_restarts is None:
+        max_total_restarts = float("inf")
     restarts = 0
+    total_restarts = 0
     while True:
         progressed = False
         try:
@@ -47,19 +64,19 @@ def run_supervised(
                 yield record
             return
         except recoverable as e:
-            # A restart that made progress resets the budget: distinguish a
-            # stream that advances between crashes from one wedged on the
-            # same failure.
             if progressed:
                 restarts = 0
             restarts += 1
-            if restarts > max_restarts:
+            total_restarts += 1
+            if restarts > max_restarts or total_restarts > max_total_restarts:
                 raise
             if on_restart is not None:
                 on_restart(restarts, e)
             logger.warning(
-                "pipeline failed (%s); restart %d/%d from checkpoint",
+                "pipeline failed (%s); restart %d/%d (total %d/%d) from checkpoint",
                 e,
                 restarts,
                 max_restarts,
+                total_restarts,
+                max_total_restarts,
             )

@@ -316,3 +316,34 @@ def test_jax_board_single_process_identity():
 def test_distributed_env_single_process():
     env = mh.distributed_env()
     assert env == mh.HostEnv(0, 1)
+
+
+def test_lockstep_collective_timeout_fails_fast():
+    """A wedged peer must surface as TimeoutError, not an eternal hang
+    (ADVICE r1: lockstep path had no deadline)."""
+    import threading
+
+    import pytest
+
+    from gelly_streaming_tpu.core.types import EdgeBatch
+    from gelly_streaming_tpu.parallel.multihost import lockstep_tumbling_windows
+
+    hang = threading.Event()
+
+    def wedged_allgather(mark):
+        hang.wait(30)  # simulates a crashed peer never joining the round
+        return np.array([mark])
+
+    batches = [
+        EdgeBatch.from_arrays(
+            np.array([1], np.int32), np.array([2], np.int32),
+            time=np.array([10], np.int64),
+        )
+    ]
+    with pytest.raises(TimeoutError):
+        list(
+            lockstep_tumbling_windows(
+                iter(batches), 100, wedged_allgather, timeout=0.2
+            )
+        )
+    hang.set()

@@ -203,3 +203,27 @@ def test_on_restart_hook_observes_failures(tmp_path):
         )
     )
     assert seen == [(1, "source died")]
+
+
+def test_total_restart_cap_binds_on_progress_then_crash():
+    """A pipeline that re-emits a record then crashes deterministically used
+    to reset the consecutive budget forever; the absolute cap now binds
+    (ADVICE r1)."""
+    import pytest
+
+    from gelly_streaming_tpu.utils.recovery import run_supervised
+
+    attempts = []
+
+    def make_stream():
+        attempts.append(1)
+
+        def gen():
+            yield ("progress",)  # resets the consecutive budget every time
+            raise RuntimeError("deterministic crash after progress")
+
+        return gen()
+
+    with pytest.raises(RuntimeError):
+        list(run_supervised(make_stream, max_restarts=2, max_total_restarts=5))
+    assert len(attempts) == 6  # initial run + 5 restarts, then give up
