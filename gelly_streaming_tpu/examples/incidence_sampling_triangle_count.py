@@ -9,7 +9,10 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
+
 from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.library.incidence_sampling import MeshSampledTriangleCount
 from gelly_streaming_tpu.library.sampled_triangles import (
     IncidenceSamplingTriangleCount,
 )
@@ -21,7 +24,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     args = parse_argv(argv, USAGE, 3)
     samples = int(args[2]) if len(args) > 2 else 1000
     stream, output = input_stream(args)
-    emit(IncidenceSamplingTriangleCount(num_samplers=samples).run(stream), output)
+    n_dev = len(jax.devices())
+    if n_dev > 1 and samples % n_dev == 0:
+        # real routed topology: host router -> sharded sampler lanes
+        algo = MeshSampledTriangleCount(samples, mode="incidence")
+    else:
+        algo = IncidenceSamplingTriangleCount(num_samplers=samples)
+    emit(algo.run(stream), output)
 
 
 if __name__ == "__main__":

@@ -10,6 +10,10 @@ from gelly_streaming_tpu.library.connected_components import (
 from gelly_streaming_tpu.library.degree_distribution import DegreeDistribution
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
 from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
+from gelly_streaming_tpu.library.incidence_sampling import (
+    IncidenceRouter,
+    MeshSampledTriangleCount,
+)
 from gelly_streaming_tpu.library.sampled_triangles import (
     BroadcastTriangleCount,
     IncidenceSamplingTriangleCount,
@@ -28,6 +32,8 @@ __all__ = [
     "CentralizedWeightedMatching",
     "BroadcastTriangleCount",
     "IncidenceSamplingTriangleCount",
+    "IncidenceRouter",
+    "MeshSampledTriangleCount",
     "Spanner",
     "ExactTriangleCount",
     "window_triangles",

@@ -273,38 +273,41 @@ def multihost_tumbling_windows(
     yield from em.drain_through(board.global_max_pane())
 
 
-def _collective_with_deadline(fn: Callable, arg, timeout: Optional[float]):
-    """Run a (potentially hanging) collective with a wall-clock deadline.
+class _DeadlineRunner:
+    """Run (potentially hanging) collectives with a wall-clock deadline.
 
     A crashed peer leaves survivors blocked inside the allgather forever —
-    the transport has no side channel.  The call runs on a watchdog thread;
-    exceeding ``timeout`` raises TimeoutError on the caller so the survivor
-    fails fast (the blocked daemon thread is abandoned; the process is
-    expected to tear down / restart its distributed context on this error).
+    the transport has no side channel.  Calls run on ONE long-lived worker
+    thread (no per-round thread churn on the ingest hot path); exceeding
+    ``timeout`` raises TimeoutError on the caller so the survivor fails fast.
+    After a timeout the worker is considered poisoned (it may never return)
+    and a fresh one is created for any subsequent call; the process is
+    expected to tear down / restart its distributed context on this error.
     """
-    if timeout is None:
-        return fn(arg)
-    result: dict = {}
-    done = threading.Event()
 
-    def target():
+    def __init__(self):
+        self._pool = None
+
+    def run(self, fn: Callable, arg, timeout: Optional[float]):
+        if timeout is None:
+            return fn(arg)
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import TimeoutError as FutureTimeout
+
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="watermark-deadline"
+            )
+        future = self._pool.submit(fn, arg)
         try:
-            result["value"] = fn(arg)
-        except BaseException as e:  # surfaced on the caller
-            result["error"] = e
-        finally:
-            done.set()
-
-    t = threading.Thread(target=target, daemon=True)
-    t.start()
-    if not done.wait(timeout):
-        raise TimeoutError(
-            f"watermark collective exceeded {timeout}s — peer host crashed "
-            "or wedged; tear down and restart the distributed context"
-        )
-    if "error" in result:
-        raise result["error"]
-    return result["value"]
+            return future.result(timeout=timeout)
+        except FutureTimeout:
+            self._pool = None  # worker is stuck in the collective: abandon it
+            raise TimeoutError(
+                f"watermark collective exceeded {timeout}s — peer host "
+                "crashed or wedged; tear down and restart the distributed "
+                "context"
+            ) from None
 
 
 def lockstep_tumbling_windows(
@@ -335,10 +338,11 @@ def lockstep_tumbling_windows(
     em = _GatedEmitter(panes)
     local_mark = -1
     max_pane = -1  # running max of real pane ids seen anywhere
+    deadline = _DeadlineRunner()
 
     def agree(mark: int):
         nonlocal max_pane
-        marks = _collective_with_deadline(allgather, mark, timeout)
+        marks = deadline.run(allgather, mark, timeout)
         real = marks[marks != END]
         if len(real):
             max_pane = max(max_pane, int(real.max()))

@@ -64,21 +64,30 @@ def device_route(
     capacity: int,
     key: str = "src",
     axis_name: str = SHARD_AXIS,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Re-key this shard's edges to their owner shards (call inside shard_map).
 
     Buckets local edges into a [S, cap] send buffer (scatter by per-owner
     occurrence rank), then ``all_to_all`` swaps buffers so each shard receives
     the edges it owns.  Overflow beyond ``cap`` per (sender, receiver) pair is
-    dropped — size cap for the worst expected skew (SURVEY.md §7 notes salting
-    for power-law keys as future work).
+    dropped and COUNTED: the last return value is this shard's scalar dropped
+    count — never silent.  Size cap for the worst expected skew, check the
+    counter, or use ``device_route_salted`` for power-law keys (SURVEY.md §7).
 
-    Returns (src, dst, mask) of the received edges, flattened to [S * cap].
+    Returns (src, dst, mask, dropped) with edges flattened to [S * cap].
     """
     routing_key = src if key == "src" else dst
     owner = jnp.where(mask, routing_key % num_shards, num_shards - 1)
+    return _exchange_by_owner(
+        src, dst, mask, owner, num_shards, capacity, axis_name
+    )
+
+
+def _exchange_by_owner(src, dst, mask, owner, num_shards, capacity, axis_name):
+    """Scatter rows into [S, cap] send buffers by ``owner`` and all_to_all."""
     rank = segments.occurrence_rank(owner, mask)
     ok = mask & (rank < capacity)
+    dropped = jnp.sum((mask & ~ok).astype(jnp.int32))
     slot = jnp.where(ok, owner * capacity + rank, num_shards * capacity)
 
     def build(buf_fill, values):
@@ -97,4 +106,42 @@ def device_route(
         recv_src.reshape(-1),
         recv_dst.reshape(-1),
         recv_mask.reshape(-1),
+        dropped,
+    )
+
+
+def device_route_salted(
+    src: jax.Array,
+    dst: jax.Array,
+    mask: jax.Array,
+    num_shards: int,
+    capacity: int,
+    key: str = "src",
+    axis_name: str = SHARD_AXIS,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Skew-safe routing for *associative* keyed aggregation: hot keys spread.
+
+    The reference's keyBy sends every record of a key to one subtask — a
+    power-law hub key makes that subtask (here: one (sender, receiver) bucket)
+    the bottleneck, and under a fixed cap the hub's overflow drops.  Salting
+    fans each key's k-th local occurrence out to shard
+    ``(owner + k // capacity_share) % S``-style rotation; here: salt =
+    occurrence-rank of the key, so a key with r local occurrences lands on
+    ``min(r, S)`` distinct shards, each receiving at most
+    ``ceil(r / S) + (other keys)`` rows.  Receivers hold *partial* per-key
+    state; the caller completes the aggregation with a second-stage combine
+    (``psum`` of dense per-key partials, or a second exact ``device_route`` of
+    the much-smaller partial summaries) — the classic two-stage/salted
+    combine for skewed keys.
+
+    Same return shape as ``device_route``; the dropped counter stays (a batch
+    can still exceed S*cap total), but a uniform spread of any single hot key
+    makes drops a function of total volume, not key skew.
+    """
+    routing_key = src if key == "src" else dst
+    base_owner = jnp.where(mask, routing_key % num_shards, num_shards - 1)
+    salt = segments.occurrence_rank(routing_key, mask)
+    owner = (base_owner + salt) % num_shards
+    return _exchange_by_owner(
+        src, dst, mask, owner, num_shards, capacity, axis_name
     )

@@ -73,10 +73,12 @@ def run_supervised(
             if on_restart is not None:
                 on_restart(restarts, e)
             logger.warning(
-                "pipeline failed (%s); restart %d/%d (total %d/%d) from checkpoint",
+                "pipeline failed (%s); restart %d/%d (total %d/%s) from checkpoint",
                 e,
                 restarts,
                 max_restarts,
                 total_restarts,
-                max_total_restarts,
+                "unbounded"
+                if max_total_restarts == float("inf")
+                else max_total_restarts,
             )
