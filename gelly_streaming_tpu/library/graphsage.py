@@ -92,17 +92,29 @@ class GraphSAGEWindows:
         self.features = jnp.asarray(features)
 
     def run(self, snapshot: SnapshotStream) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yields (keys [K], embeddings [K, F_out]) per closed window."""
-        for hood in snapshot._neighborhood_panes():
-            emb = sage_kernel_jit(
-                self.params,
-                self.features,
-                jnp.asarray(hood.keys),
-                jnp.asarray(hood.nbrs),
-                jnp.asarray(hood.valid),
-            )
-            n = hood.num_keys
-            yield hood.keys[:n], np.asarray(emb.astype(jnp.float32))[:n]
+        """Yields (keys [K], embeddings [K, F_out]) per closed window.
+
+        Panes arrive as degree buckets (core/snapshot.py); the kernel runs per
+        bucket — smaller, tighter [K_b, D_b] tensors — and one record per
+        window concatenates the buckets' rows."""
+        import itertools
+
+        for _, hoods in itertools.groupby(
+            snapshot._neighborhood_panes(), key=lambda h: h.pane.window_id
+        ):
+            ks, es = [], []
+            for hood in hoods:
+                emb = sage_kernel_jit(
+                    self.params,
+                    self.features,
+                    jnp.asarray(hood.keys),
+                    jnp.asarray(hood.nbrs),
+                    jnp.asarray(hood.valid),
+                )
+                n = hood.num_keys
+                ks.append(np.asarray(hood.keys)[:n])
+                es.append(np.asarray(emb.astype(jnp.float32))[:n])
+            yield np.concatenate(ks), np.concatenate(es)
 
     def output(self, snapshot: SnapshotStream) -> OutputStream:
         """(vertex, embedding-norm) records — a compact observable stream."""

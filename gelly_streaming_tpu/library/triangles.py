@@ -197,13 +197,149 @@ def triangle_update(
     return TriangleCountState(table, local, glob), local_trace, global_trace
 
 
-class ExactTriangleCount:
-    """Host-facing runner: continuous (key, count) updates, key -1 = global."""
+def triangle_update_block(
+    state: TriangleCountState, src, dst, mask, chunk: int = 64
+) -> TriangleCountState:
+    """Batch-vectorized exact triangle fold — same final state as
+    ``triangle_update``, without the per-edge trace (VERDICT r1 item 7).
 
-    def __init__(self, cfg: Optional[StreamConfig] = None):
+    The per-edge scan pays a [D] gather + [D, D] comparison per edge,
+    sequentially.  Here the batch folds in chunks of ``chunk`` edges; per
+    chunk ONE set of dense tensor ops handles all three ways a chunk edge
+    (u, v) can close a wedge u–w–v (attribution to the LAST arriving edge of
+    each triangle, as in the single-pass algorithm,
+    ExactTriangleCount.java:74-116):
+
+      old-old:  both wedge edges pre-chunk — a [r, D, D] masked equality
+                reduction over the endpoints' adjacency rows;
+      old-new:  one wedge edge earlier in the chunk, the other pre-chunk —
+                a [r, r, D] membership test against the gathered rows;
+      new-new:  both wedge edges earlier in the chunk — a [r, r, r]
+                elementwise condition tensor (no lookups at all).
+
+    Cross-chunk dependencies need nothing special: chunks fold sequentially
+    and earlier chunks are already in the table ("old").  Duplicate edges are
+    ignored exactly as in the scan path (table membership + first-occurrence
+    within the chunk).
+    """
+    capacity, max_degree = state.table.nbrs.shape
+    from gelly_streaming_tpu.ops import segments
+
+    b = src.shape[0]
+    r = min(chunk, b)
+    pad = (-b) % r
+    if pad:
+        src = jnp.concatenate([src, jnp.zeros((pad,), src.dtype)])
+        dst = jnp.concatenate([dst, jnp.zeros((pad,), dst.dtype)])
+        mask = jnp.concatenate([mask, jnp.zeros((pad,), bool)])
+    n_chunks = (b + pad) // r
+    lo = jnp.minimum(src, dst).reshape(n_chunks, r)
+    hi = jnp.maximum(src, dst).reshape(n_chunks, r)
+    ok0 = (mask & (jnp.minimum(src, dst) != jnp.maximum(src, dst))).reshape(
+        n_chunks, r
+    )
+
+    lower = jnp.tril(jnp.ones((r, r), bool), -1)  # [j, i]: i < j
+
+    def step(carry, inp):
+        table, local, glob = carry
+        lo, hi, ok = inp
+        ok = (
+            ok
+            & ~nbr_ops.contains_batch(table, lo, hi)
+            & segments.first_occurrence_mask_pairs(lo, hi, ok)
+        )
+        row_lo, valid_lo = nbr_ops.gather_rows(table, lo)  # [r, D]
+        row_hi, valid_hi = nbr_ops.gather_rows(table, hi)
+
+        # -- old-old: [r, D, D]
+        eq = (
+            (row_lo[:, :, None] == row_hi[:, None, :])
+            & valid_lo[:, :, None]
+            & valid_hi[:, None, :]
+        )
+        c1 = jnp.where(ok, jnp.sum(eq, axis=(1, 2)), 0)
+        common1 = eq.any(axis=2) & ok[:, None]  # marks on row_lo slots
+
+        # pair geometry among chunk edges: does e_i touch e_j's endpoints?
+        pair_ok = lower & ok[:, None] & ok[None, :]  # [j, i]
+        i_lo, i_hi = lo[None, :], hi[None, :]  # e_i endpoints, broadcast on j
+        shares_lo = (i_lo == lo[:, None]) | (i_hi == lo[:, None])  # e_i ∋ lo_j
+        shares_hi = (i_lo == hi[:, None]) | (i_hi == hi[:, None])  # e_i ∋ hi_j
+        w_lo = jnp.where(i_lo == lo[:, None], i_hi, i_lo)  # other end of e_i
+        w_hi = jnp.where(i_lo == hi[:, None], i_hi, i_lo)
+
+        # -- old-new: wedge edge e_i in chunk (earlier), mate edge pre-chunk.
+        # (lo_j, w)=e_i and (hi_j, w) old  <=>  w in row_hi[j]; and symmetric.
+        def member(rows, valid, w):  # [j, D] rows vs [j, i] queries
+            return jnp.any(
+                (rows[:, None, :] == w[:, :, None]) & valid[:, None, :], axis=2
+            )
+
+        c2a = pair_ok & shares_lo & member(row_hi, valid_hi, w_lo)
+        c2b = pair_ok & shares_hi & member(row_lo, valid_lo, w_hi)
+        c2 = jnp.sum(c2a, axis=1) + jnp.sum(c2b, axis=1)
+
+        # -- new-new: wedge edges e_i (∋ lo_j) and e_k (∋ hi_j), both earlier,
+        # meeting at the same w: [j, i, k]
+        a3 = pair_ok & shares_lo  # [j, i]
+        b3 = pair_ok & shares_hi  # [j, k]
+        cond3 = (
+            a3[:, :, None]
+            & b3[:, None, :]
+            & (w_lo[:, :, None] == w_hi[:, None, :])
+        )
+        c3 = jnp.sum(cond3, axis=(1, 2))
+        w3_weight = jnp.sum(cond3, axis=2)  # per (j, i): marks on w_lo[j, i]
+
+        c = c1 + c2 + c3
+        # counter updates (SumAndEmitCounters semantics): endpoints get c,
+        # each common w gets +1, the global key accumulates everything
+        local = local.at[jnp.where(common1, row_lo, 0)].add(
+            common1.astype(jnp.int32)
+        )
+        local = local.at[jnp.where(c2a, w_lo, 0)].add(c2a.astype(jnp.int32))
+        local = local.at[jnp.where(c2b, w_hi, 0)].add(c2b.astype(jnp.int32))
+        local = local.at[jnp.where(w3_weight > 0, w_lo, 0)].add(w3_weight)
+        local = local.at[jnp.where(ok, lo, 0)].add(jnp.where(ok, c, 0))
+        local = local.at[jnp.where(ok, hi, 0)].add(jnp.where(ok, c, 0))
+        glob = glob + jnp.sum(c)
+        table = nbr_ops.insert_batch(
+            table,
+            jnp.concatenate([lo, hi]),
+            jnp.concatenate([hi, lo]),
+            jnp.concatenate([ok, ok]),
+        )
+        return (table, local, glob), None
+
+    (table, local, glob), _ = jax.lax.scan(
+        step, (state.table, state.local, state.global_count), (lo, hi, ok0)
+    )
+    return TriangleCountState(table, local, glob)
+
+
+class ExactTriangleCount:
+    """Host-facing runner: continuous (key, count) updates, key -1 = global.
+
+    ``mode="trace"`` (default) emits the reference's exact per-edge running
+    trace via the sequential scan kernel; ``mode="block"`` rides the chunk-
+    vectorized fold (triangle_update_block) and emits one block of running
+    (key, count) records per micro-batch — the endpoints it touched plus the
+    global key — the per-batch relaxation SURVEY §7 anticipates for batched
+    execution.
+    """
+
+    def __init__(self, cfg: Optional[StreamConfig] = None, mode: str = "trace"):
+        if mode not in ("trace", "block"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
         self._kernel = jax.jit(triangle_update)
+        self._block_kernel = jax.jit(triangle_update_block)
 
     def run(self, stream) -> OutputStream:
+        if self.mode == "block":
+            return self._run_blocks(stream)
+
         def records():
             state = init_triangle_state(stream.cfg)
             for batch in stream.batches():
@@ -223,3 +359,37 @@ class ExactTriangleCount:
             self.final_state = state
 
         return OutputStream(records)
+
+    def _run_blocks(self, stream) -> OutputStream:
+        from gelly_streaming_tpu.core.output import RecordBlock
+
+        def blocks():
+            state = init_triangle_state(stream.cfg)
+            prev_local = np.asarray(state.local)
+            for batch in stream.batches():
+                state = self._block_kernel(
+                    state, batch.src, batch.dst, batch.mask
+                )
+                m_h = np.asarray(batch.mask)
+                local_h = np.asarray(state.local)
+                # endpoints of the batch plus every vertex whose counter moved
+                # (common neighbors w also get updates in the reference,
+                # ExactTriangleCount.java:95-104)
+                touched = np.unique(
+                    np.concatenate(
+                        [
+                            np.asarray(batch.src)[m_h],
+                            np.asarray(batch.dst)[m_h],
+                            np.nonzero(local_h != prev_local)[0],
+                        ]
+                    )
+                )
+                prev_local = local_h
+                keys = np.concatenate([touched, [GLOBAL_KEY]]).astype(np.int64)
+                counts = np.concatenate(
+                    [local_h[touched], [int(state.global_count)]]
+                )
+                yield RecordBlock((keys, counts))
+            self.final_state = state
+
+        return OutputStream(blocks_fn=blocks)

@@ -1,0 +1,126 @@
+"""Device-side, degree-bucketed neighborhood grouping for window panes.
+
+Replaces the host numpy sort in the snapshot path (reference: the per-window
+keyed grouping Flink performs inside ``WindowedStream.apply``,
+SnapshotStream.java:129-181).  Two properties matter:
+
+* **On device.**  The pane ships as its edge list (8 B/edge up) and the
+  grouping — sort by key, dense key ids, within-key ranks, scatters — runs as
+  one jitted program.  The host build it replaces uploaded the padded
+  [K, D_max] tensors instead, which under skew is far larger than E.
+
+* **Degree-bucketed.**  One hub vertex used to inflate the whole pane tensor
+  to [K, max_degree] (SURVEY.md §7, ``applyOnNeighbors`` padding).  Here keys
+  land in buckets by degree class: bucket b holds keys with degree in
+  (2^(b-1), 2^b], padded to [K_b, 2^b] with K_b = min(E, 2E/2^b) — at most
+  E/2^(b-1) keys can have degree > 2^(b-1), so the shapes are static in E and
+  total padded area is O(E log E) instead of O(K * max_degree).
+
+All shapes derive from the pow2-padded edge count, so successive panes of
+similar size reuse compiled kernels.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gelly_streaming_tpu.ops import segments
+
+
+class NeighborhoodBucket(NamedTuple):
+    """One degree class of a pane: padded [K_b, D_b] tensors (device)."""
+
+    keys: jax.Array  # int32[K_b]
+    nbrs: jax.Array  # int32[K_b, D_b]
+    vals: Optional[object]  # pytree of [K_b, D_b] or None
+    valid: jax.Array  # bool[K_b, D_b]
+    num_keys: jax.Array  # int32[] — real keys in this bucket
+
+
+def bucket_shapes(e_pad: int) -> List[tuple]:
+    """Static (K_b, D_b) per degree bucket for a pow2 edge capacity."""
+    shapes = []
+    b = 0
+    while (1 << b) <= e_pad:
+        d = 1 << b
+        k = max(1, min(e_pad, (2 * e_pad) // d))
+        shapes.append((k, d))
+        b += 1
+    return shapes
+
+
+def build_buckets(src, dst, val, mask) -> List[NeighborhoodBucket]:
+    """Group a padded edge list by source key into degree buckets (traceable).
+
+    ``src``/``dst``/``mask``: [E] with E a power of two; ``val``: optional
+    pytree of [E] edge values.  Returns one NeighborhoodBucket per degree
+    class (possibly with num_keys == 0); neighbor columns within a key are in
+    arrival order (stable sort), matching the reference's per-window neighbor
+    iteration order.
+    """
+    e = src.shape[0]
+    order, sorted_gk = segments.sort_by_key(src, mask)
+    ks = src[order]
+    kd = dst[order]
+    kmask = mask[order]
+    kval = None if val is None else jax.tree.map(lambda a: a[order], val)
+    boundary = segments.segment_boundaries(sorted_gk)
+    key_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # dense key rank [E]
+    pos = jnp.arange(e, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(boundary, pos, 0))
+    col = pos - seg_start  # within-key arrival rank
+
+    # per-key tables over [E] slots (at most E distinct keys)
+    deg = jnp.zeros((e,), jnp.int32).at[key_id].add(kmask.astype(jnp.int32))
+    key_of = jnp.zeros((e,), jnp.int32).at[jnp.where(kmask, key_id, 0)].max(
+        jnp.where(kmask, ks, 0)
+    )
+    key_valid = deg > 0
+    # degree class: deg in (2^(b-1), 2^b] -> bucket b  (ceil log2)
+    bucket_of = jnp.where(
+        key_valid, jnp.ceil(jnp.log2(jnp.maximum(deg, 1))).astype(jnp.int32), -1
+    )
+
+    out: List[NeighborhoodBucket] = []
+    for b, (k_b, d_b) in enumerate(bucket_shapes(e)):
+        in_b = key_valid & (bucket_of == b)  # per key slot [E]
+        row_of = jnp.cumsum(in_b.astype(jnp.int32)) - 1  # dense row in bucket
+        keys_b = (
+            jnp.zeros((k_b,), jnp.int32)
+            .at[jnp.where(in_b, jnp.minimum(row_of, k_b - 1), k_b)]
+            .max(key_of, mode="drop")
+        )
+        # per edge: does my key live in this bucket?
+        esel = kmask & in_b[key_id]
+        erow = jnp.where(esel, row_of[key_id], k_b)
+        ecol = jnp.minimum(col, d_b - 1)  # esel guarantees col < d_b
+        nbrs_b = (
+            jnp.zeros((k_b, d_b), jnp.int32)
+            .at[erow, ecol]
+            .set(jnp.where(esel, kd, 0), mode="drop")
+        )
+        valid_b = (
+            jnp.zeros((k_b, d_b), bool).at[erow, ecol].max(esel, mode="drop")
+        )
+        vals_b = None
+        if kval is not None:
+            vals_b = jax.tree.map(
+                lambda a: jnp.zeros((k_b, d_b) + a.shape[1:], a.dtype)
+                .at[erow, ecol]
+                .set(
+                    jnp.where(
+                        esel.reshape((-1,) + (1,) * (a.ndim - 1)), a, 0
+                    ),
+                    mode="drop",
+                ),
+                kval,
+            )
+        out.append(
+            NeighborhoodBucket(
+                keys_b, nbrs_b, vals_b, valid_b, jnp.sum(in_b.astype(jnp.int32))
+            )
+        )
+    return out
