@@ -43,6 +43,12 @@ class SummaryAggregation:
     """
 
     transient_state: bool = False
+    # True when transform(fold(edges)) is invariant under reordering edges
+    # within (and across) micro-batches — e.g. union-find CC, parity
+    # union-find bipartiteness.  Order-free descriptors may legally ride the
+    # sorted EF40 multiset wire encoding (io/wire.py), which ships ~2x fewer
+    # bytes per edge than the plain arrival-order pack.
+    order_free: bool = False
 
     def __init__(self, window_ms: Optional[int] = None):
         self.window_ms = window_ms
@@ -109,17 +115,19 @@ class SummaryAggregation:
     # is a fold over edges, so folding batch-by-batch into one running state is
     # exactly the single-partition pane fold of the simulated path.
 
-    def _wire_eligible(self, stream, checkpoint_path) -> bool:
+    def _wire_eligible(self, stream) -> bool:
         return (
-            checkpoint_path is None
-            and getattr(stream, "_wire_arrays", None) is not None
+            getattr(stream, "_wire_arrays", None) is not None
             and self._num_partitions(stream.cfg) == 1
         )
 
     def _wire_fused_step(self, stream, batch: int, width):
         """Jitted (stage-states, summary), wire-buffer -> carry step, cached so
         repeated runs over the same stream/shape reuse the compiled kernel."""
-        key = (id(stream._stages), stream.cfg, batch, str(width), "wire")
+        # Key on the stages tuple itself (strong ref), not id(): an id can be
+        # reused after GC, silently resurrecting a kernel compiled for a
+        # DIFFERENT stream's stages (e.g. another filter predicate).
+        key = (stream._stages, stream.cfg, batch, str(width), "wire")
         cache = getattr(self, "_wire_step_cache", None)
         if cache is None:
             cache = self._wire_step_cache = {}
@@ -153,33 +161,151 @@ class SummaryAggregation:
         cache[key] = entry
         return entry
 
-    def _wire_records(self, stream) -> Iterator[tuple]:
+    def _wire_width(self, cfg: StreamConfig):
+        """Resolve the wire encoding for this descriptor + config.
+
+        "auto" picks EF40 (sorted multiset, ~2x fewer bytes) only when the
+        descriptor is order-free, ids fit in 20 bits, and the host has spare
+        cores to sort on — on a single-core host the per-batch radix sort
+        competes with the transfer path for the same CPU and measures slower
+        than shipping the plain 40-bit pack (BASELINE.md round 3).
+        """
+        from gelly_streaming_tpu.io import wire
+
+        enc = cfg.wire_encoding
+        if enc == "auto":
+            enc = (
+                "ef40"
+                if (
+                    self.order_free
+                    and cfg.vertex_capacity <= 1 << 20
+                    and (os.cpu_count() or 1) >= 2
+                )
+                else "plain"
+            )
+        if enc == "ef40":
+            if not self.order_free:
+                raise ValueError(
+                    "wire_encoding='ef40' ships a sorted multiset; this "
+                    "aggregation is not order-free"
+                )
+            if cfg.vertex_capacity > 1 << 20:
+                raise ValueError("ef40 wire encoding needs vertex_capacity <= 2^20")
+            return (wire.EF40, cfg.vertex_capacity)
+        return wire.width_for_capacity(cfg.vertex_capacity)
+
+    def _wire_checkpoint_like(self, stream):
+        """Wire-path snapshot layout: the FULL fold carry (stage states +
+        summary — closing the reference's unsaved-operator-state gap,
+        SURVEY.md §5.3) plus the stream position in full batches."""
+        cfg = stream.cfg
+        return {
+            "summary": self.initial_state(cfg),
+            "stages": tuple(stage.init(cfg) for stage in stream._stages),
+            "next_batch": np.zeros((), np.int64),
+            # position is in units of full batches, so a resume under a
+            # different batch_size would skip/refold the wrong edges — the
+            # stored size makes that a hard error instead of silent corruption
+            "batch": np.zeros((), np.int64),
+            "done": np.zeros((), bool),
+        }
+
+    def _wire_records(
+        self,
+        stream,
+        checkpoint_path: Optional[str] = None,
+        restore: bool = True,
+    ) -> Iterator[tuple]:
+        """The packed-wire fast path, with optional positional checkpoints.
+
+        Unlike the reference — whose Merger checkpoints inside the full-speed
+        pipeline (SummaryAggregation.java:127-135) but loses all other
+        operator state — the snapshot here is the WHOLE fold carry plus the
+        batch position, taken every ``cfg.wire_checkpoint_batches`` full
+        batches and at stream end.  On restore the source replays from the
+        start and already-folded batches are skipped by position (the same
+        replay contract as the windowed `_merge_loop`); state is exactly-once,
+        the final emission is at-least-once.  A snapshot downloads the carry
+        (device->host), so the interval trades recovery granularity against
+        sustained ingest rate — at the default every-64-batches the cost is
+        amortized to well under a percent of stream time on a PCIe host.
+        """
         from gelly_streaming_tpu.io import wire
 
         cfg = stream.cfg
         src, dst, batch = stream._wire_arrays
         batch = min(batch, max(len(src), 1))
-        width = wire.width_for_capacity(cfg.vertex_capacity)
+        width = self._wire_width(cfg)
         fused, tail = self._wire_fused_step(stream, batch, width)
+        n_full = len(src) // batch
+        start_batch = 0
+        carry_host = None
+        if checkpoint_path and restore:
+            from gelly_streaming_tpu.utils.checkpoint import (
+                checkpoint_exists,
+                load_state,
+            )
+
+            if checkpoint_exists(checkpoint_path):
+                snap = load_state(checkpoint_path, self._wire_checkpoint_like(stream))
+                if int(snap["batch"]) != batch:
+                    raise ValueError(
+                        f"wire checkpoint was written with batch_size "
+                        f"{int(snap['batch'])}; resuming with {batch} would "
+                        "misalign the stream position"
+                    )
+                if bool(snap["done"]):
+                    # stream fully folded before the crash: re-emit (the
+                    # at-least-once contract) without re-folding
+                    out = self.transform(snap["summary"])
+                    yield out if isinstance(out, tuple) else (out,)
+                    return
+                start_batch = int(snap["next_batch"])
+                carry_host = (snap["stages"], snap["summary"])
         # committed placement so the first and later calls share one jit entry
         carry = jax.device_put(
-            (
+            carry_host
+            if carry_host is not None
+            else (
                 tuple(stage.init(cfg) for stage in stream._stages),
                 self.initial_state(cfg),
             ),
             jax.devices()[0],
         )
-        n_full = len(src) // batch
+
+        def snapshot(pos: int, done: bool, carry_now):
+            from gelly_streaming_tpu.utils.checkpoint import save_state
+
+            host = jax.tree.map(np.asarray, carry_now)
+            save_state(
+                checkpoint_path,
+                {
+                    "summary": host[1],
+                    "stages": host[0],
+                    "next_batch": np.full((), pos, np.int64),
+                    "batch": np.full((), batch, np.int64),
+                    "done": np.full((), done, bool),
+                },
+            )
+
+        every = cfg.wire_checkpoint_batches
+        since_snap = 0
 
         def full_batches():
-            for i in range(n_full):
+            for i in range(start_batch, n_full):
                 yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
 
         with wire.WirePrefetcher(
             full_batches(), width, depth=cfg.prefetch_depth
         ) as pf:
-            for buf, _ in pf:
+            for i, (buf, _) in enumerate(pf):
                 carry = fused(carry, buf)
+                since_snap += 1
+                if checkpoint_path and every and since_snap >= every:
+                    # the snapshot must read the carry BEFORE the next fused
+                    # call donates it away
+                    snapshot(start_batch + i + 1, False, carry)
+                    since_snap = 0
         rem = len(src) - n_full * batch
         if rem:
             mask = np.zeros((batch,), bool)
@@ -197,7 +323,11 @@ class SummaryAggregation:
         if len(src) == 0:
             return
         out = self.transform(carry[1])
+        # emit BEFORE the final snapshot: a crash between the two re-emits on
+        # recovery (at-least-once) instead of dropping the record
         yield out if isinstance(out, tuple) else (out,)
+        if checkpoint_path:
+            snapshot(n_full, True, carry)
 
     def _checkpoint_like(self, cfg):
         """Checkpoint structure: summary + presence flag + stream position.
@@ -240,8 +370,10 @@ class SummaryAggregation:
         runs the real sharded data plane (MeshAggregationRunner); otherwise
         partitions are simulated sequentially (the MiniCluster shape).  All
         paths share the Merger/checkpoint loop (`_merge_loop`)."""
-        if self._wire_eligible(stream, checkpoint_path):
-            return OutputStream(lambda: self._wire_records(stream))
+        if self._wire_eligible(stream):
+            return OutputStream(
+                lambda: self._wire_records(stream, checkpoint_path, restore)
+            )
         cfg = stream.cfg
         if cfg.num_shards > 1 and cfg.num_shards <= len(jax.devices()):
             return self._mesh_runner(cfg).run(

@@ -29,6 +29,15 @@ class StreamConfig:
       tree_degree: fan-in of the tree combine (SummaryTreeReduce.java:53-64 analog).
       prefetch_depth: packed-wire transfers kept in flight ahead of the device
         consumer on the fast ingest path (io/wire.py WirePrefetcher).
+      wire_encoding: ingest wire format on the packed fast path.  "plain"
+        ships each batch in arrival order at the narrowest fixed width
+        (io/wire.py width_for_capacity).  "ef40" sorts each micro-batch and
+        ships the Elias-Fano multiset (~2.6-2.9 B/edge vs 5) — legal only for
+        order-free aggregations (SummaryAggregation.order_free) with
+        vertex_capacity <= 2^20.  "auto" picks per host: ef40 when the
+        descriptor is order-free, ids fit, and the host has spare cores to
+        sort on (>= 2); plain otherwise (on a single-core host the radix sort
+        competes with the transfer for the same CPU and loses).
     """
 
     vertex_capacity: int = 1 << 16
@@ -38,8 +47,17 @@ class StreamConfig:
     window_ms: int = 1000
     tree_degree: int = 2
     prefetch_depth: int = 8
+    wire_encoding: str = "auto"
+    # full batches between positional snapshots on the wire fast path (0 =
+    # snapshot only at stream end); each snapshot downloads the fold carry,
+    # so the interval trades recovery granularity against ingest rate
+    wire_checkpoint_batches: int = 64
 
     def __post_init__(self):
+        if self.wire_encoding not in ("auto", "plain", "ef40"):
+            raise ValueError(f"unknown wire_encoding {self.wire_encoding!r}")
+        if self.wire_checkpoint_batches < 0:
+            raise ValueError("wire_checkpoint_batches must be >= 0")
         if self.vertex_capacity <= 0:
             raise ValueError("vertex_capacity must be positive")
         if self.num_shards <= 0:

@@ -628,10 +628,23 @@ class EdgeStream:
 
         return SnapshotStream(self, window_ms or self.cfg.window_ms, direction)
 
-    def aggregate(self, summary_aggregation) -> OutputStream:
+    def aggregate(
+        self,
+        summary_aggregation,
+        checkpoint_path: Optional[str] = None,
+        restore: bool = True,
+    ) -> OutputStream:
         """Run a summary aggregation over this stream
-        (GraphStream.java:139-140 -> SummaryAggregation.run)."""
-        return summary_aggregation.run(self)
+        (GraphStream.java:139-140 -> SummaryAggregation.run).
+
+        With ``checkpoint_path`` the running summary and stream position are
+        snapshot as the stream folds and restored on start — on every
+        execution path, including the packed-wire fast path (the reference
+        checkpoints inside its full-speed pipeline the same way,
+        SummaryAggregation.java:127-135)."""
+        return summary_aggregation.run(
+            self, checkpoint_path=checkpoint_path, restore=restore
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,10 @@ class BPState(NamedTuple):
 class BipartitenessCheck(SummaryBulkAggregation):
     """aggregate(BipartitenessCheck(window_ms)) -> stream of Candidates."""
 
+    # parity union-find reaches the same (components, conflict) fixed point
+    # in any edge order -> eligible for the EF40 multiset wire encoding
+    order_free = True
+
     def initial_state(self, cfg: StreamConfig) -> BPState:
         return BPState(
             parent2=uf.init_parity_parent(cfg.vertex_capacity),

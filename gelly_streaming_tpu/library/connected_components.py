@@ -40,6 +40,10 @@ class CCState(NamedTuple):
 class _CCMixin:
     """Shared descriptor hooks for both combine strategies."""
 
+    # the union fold reaches the same partition whatever the edge order, so
+    # CC may ride the sorted EF40 multiset wire encoding
+    order_free = True
+
     def initial_state(self, cfg: StreamConfig) -> CCState:
         return CCState(
             parent=uf.init_parent(cfg.vertex_capacity),

@@ -43,6 +43,31 @@ from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
 from gelly_streaming_tpu.utils.value_types import SampledEdge
 
 
+_M64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Counter-based 64-bit mix (splitmix64 finalizer) over uint64 arrays."""
+    with np.errstate(over="ignore"):  # uint64 wraparound is the algorithm
+        x = (x + np.uint64(0x9E3779B97F4A7C15)) & _M64
+        x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & _M64
+        x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & _M64
+        return x ^ (x >> np.uint64(31))
+
+
+def _hashed_bits(seed: int, counters: np.ndarray, stream: int) -> np.ndarray:
+    """Deterministic uint64 word per counter: hash(seed, stream, counter).
+
+    Counter-based (no per-edge Generator construction) so a whole batch of
+    (edge, lane) draws is one vectorized pass — the reference's seeded
+    sequential RNG (IncidenceSamplingTriangleCount.java:61) made routing
+    decisions reproducible; hashing the global edge index keeps that property
+    while decoupling the draws from arrival batching.
+    """
+    base = _splitmix64(np.uint64(seed & 0xFFFFFFFFFFFFFFFF) + np.uint64(stream))
+    return _splitmix64(base + counters.astype(np.uint64))
+
+
 class IncidenceRouter:
     """Host central router: one envelope per (edge, interested lane).
 
@@ -52,6 +77,11 @@ class IncidenceRouter:
     resample the edge or whose watched wedge it closes.  ``broadcast=True``
     emits an envelope for every valid lane instead (the BroadcastTriangleCount
     topology) — same decisions, maximal shipping.
+
+    The whole micro-batch routes in one vectorized pass: coin/third draws are
+    counter-hashed per (edge, lane), and each lane's state at edge j is
+    reconstructed from its last resample strictly before j (a prefix max),
+    so no per-edge Python loop or per-edge RNG construction remains.
     """
 
     def __init__(
@@ -80,47 +110,79 @@ class IncidenceRouter:
         the edge closes the lane's (edgeEndpoint, third) wedge sides).
         """
         s = self.num_samplers
-        lanes_out: List[np.ndarray] = []
-        cols = {k: [] for k in ("idx", "resample", "third", "hit_a", "hit_b")}
-        for j in range(len(src)):
-            if mask is not None and not mask[j]:
-                continue
-            u, v = int(src[j]), int(dst[j])
-            self.seen[u] = True
-            self.seen[v] = True
-            self.edges_seen += 1
-            i = self.edges_seen
-            rng = np.random.default_rng([self.seed, i])
-            coins = rng.random(s) < 1.0 / i
-            thirds = rng.integers(0, self.capacity, s)
-            # incidence vs the CURRENT samples (before applying resamples):
-            # the edge closes side a/b of a lane's wedge if it equals
-            # {edge_endpoint, third} as an unordered pair
-            lo, hi = min(u, v), max(u, v)
-            e0, e1, t = self.edge_tab[:, 0], self.edge_tab[:, 1], self.third
-            hit_a = (np.minimum(e0, t) == lo) & (np.maximum(e0, t) == hi)
-            hit_b = (np.minimum(e1, t) == lo) & (np.maximum(e1, t) == hi)
-            interested = (
-                np.ones(s, bool) if self.broadcast else (coins | hit_a | hit_b)
-            )
-            idx = np.nonzero(interested)[0]
-            lanes_out.append(idx)
-            cols["idx"].append(np.full(len(idx), i, np.int64))
-            cols["resample"].append(coins[idx])
-            cols["third"].append(np.where(coins[idx], thirds[idx], -1))
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        if mask is not None:
+            sel = np.asarray(mask, bool)
+            src, dst = src[sel], dst[sel]
+        m = len(src)
+        if m == 0:
+            out = {
+                k: np.zeros((0,), np.int64)
+                for k in ("idx", "resample", "third", "hit_a", "hit_b", "lane")
+            }
+            return out
+        self.seen[src] = True
+        self.seen[dst] = True
+        idx = self.edges_seen + 1 + np.arange(m, dtype=np.int64)  # 1-based
+        self.edges_seen += m
+
+        # vectorized draws: one counter-hashed word per (edge, lane)
+        counters = (idx[:, None] * np.int64(s) + np.arange(s, dtype=np.int64))
+        u01 = (_hashed_bits(self.seed, counters, 0) >> np.uint64(11)).astype(
+            np.float64
+        ) * (1.0 / (1 << 53))
+        coins = u01 < (1.0 / idx)[:, None]  # [m, s] 1/i reservoir coin
+        thirds = (
+            _hashed_bits(self.seed, counters, 1) % np.uint64(self.capacity)
+        ).astype(np.int64)
+
+        # each lane's state at edge row j = its last resample strictly
+        # before j this batch, else the carried state (-1 sentinel)
+        rows = np.arange(m, dtype=np.int64)
+        fired = np.where(coins, rows[:, None], np.int64(-1))
+        last_fired = np.maximum.accumulate(fired, axis=0)  # [m, s]
+        state_at = np.empty((m, s), np.int64)
+        state_at[0] = -1
+        state_at[1:] = last_fired[:-1]
+        in_batch = state_at >= 0
+        row_clip = np.clip(state_at, 0, None)
+        e0_at = np.where(in_batch, src[row_clip], self.edge_tab[None, :, 0])
+        e1_at = np.where(in_batch, dst[row_clip], self.edge_tab[None, :, 1])
+        t_at = np.where(
+            in_batch, np.take_along_axis(thirds, row_clip, axis=0), self.third
+        )
+
+        # incidence vs the CURRENT samples (before applying resamples): the
+        # edge closes side a/b of a lane's wedge if it equals
+        # {edge_endpoint, third} as an unordered pair
+        lo = np.minimum(src, dst)[:, None]
+        hi = np.maximum(src, dst)[:, None]
+        hit_a = (np.minimum(e0_at, t_at) == lo) & (np.maximum(e0_at, t_at) == hi)
+        hit_b = (np.minimum(e1_at, t_at) == lo) & (np.maximum(e1_at, t_at) == hi)
+        interested = (
+            np.ones((m, s), bool) if self.broadcast else (coins | hit_a | hit_b)
+        )
+        erow, lane = np.nonzero(interested)  # row-major: edge-major, lane asc
+
+        out = {
+            "lane": lane.astype(np.int64),
+            "idx": idx[erow],
+            "resample": coins[erow, lane],
+            "third": np.where(coins[erow, lane], thirds[erow, lane], -1),
             # a resampling lane's hits refer to the OLD wedge it just dropped
-            cols["hit_a"].append(hit_a[idx] & ~coins[idx])
-            cols["hit_b"].append(hit_b[idx] & ~coins[idx])
-            # apply resamples to the router's mirror of lane state
-            self.edge_tab[coins, 0] = u
-            self.edge_tab[coins, 1] = v
-            self.third[coins] = thirds[coins]
-        if lanes_out:
-            out = {k: np.concatenate(vs) for k, vs in cols.items()}
-            out["lane"] = np.concatenate(lanes_out)
-        else:
-            out = {k: np.zeros((0,), np.int64) for k in cols}
-            out["lane"] = np.zeros((0,), np.int64)
+            "hit_a": hit_a[erow, lane] & ~coins[erow, lane],
+            "hit_b": hit_b[erow, lane] & ~coins[erow, lane],
+        }
+        # apply the batch's net resamples to the router's mirror of lane state
+        final = last_fired[-1]
+        changed = final >= 0
+        frow = np.clip(final, 0, None)
+        self.edge_tab[changed, 0] = src[frow][changed]
+        self.edge_tab[changed, 1] = dst[frow][changed]
+        self.third[changed] = np.take_along_axis(
+            thirds, frow[None, :], axis=0
+        )[0][changed]
         return out
 
     def envelopes(

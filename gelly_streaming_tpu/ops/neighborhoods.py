@@ -79,10 +79,14 @@ def build_buckets(src, dst, val, mask) -> List[NeighborhoodBucket]:
         jnp.where(kmask, ks, 0)
     )
     key_valid = deg > 0
-    # degree class: deg in (2^(b-1), 2^b] -> bucket b  (ceil log2)
-    bucket_of = jnp.where(
-        key_valid, jnp.ceil(jnp.log2(jnp.maximum(deg, 1))).astype(jnp.int32), -1
-    )
+    # degree class: deg in (2^(b-1), 2^b] -> bucket b  (ceil log2).  Integer
+    # clz, not float log2: float32 log2(2^k + 1) rounds to exactly k for
+    # k >~ 22, which would mis-bucket huge-degree keys into a class with
+    # D_b < degree and silently overwrite the last neighbor slot.
+    ceil_log2 = jnp.where(
+        deg <= 1, 0, 32 - jax.lax.clz(jnp.maximum(deg, 2) - 1)
+    ).astype(jnp.int32)
+    bucket_of = jnp.where(key_valid, ceil_log2, -1)
 
     out: List[NeighborhoodBucket] = []
     for b, (k_b, d_b) in enumerate(bucket_shapes(e)):

@@ -135,10 +135,14 @@ def _count_from_edges(u, v, mask, k: int, interpret: bool):
     return _count_halves(adj, interpret=interpret)
 
 
-def pane_triangles_dense(
-    u: np.ndarray, v: np.ndarray, num_vertices: int, mask=None
-) -> int:
-    """Count triangles among a pane's edges via the MXU kernel.
+def pane_triangles_submit(u: np.ndarray, v: np.ndarray, num_vertices: int, mask=None):
+    """Upload + dispatch the dense pane count WITHOUT waiting for the result.
+
+    Returns the kernel's device-resident running-total halves (or None for an
+    empty pane); recombine with ``triangles_from_halves`` when the value is
+    needed.  Splitting submit from fetch lets a pipelined caller overlap the
+    next pane's transfer/compute with this pane's readback RTT — on a
+    tunneled device the readback latency otherwise lands on every window.
 
     ``u``/``v`` may contain duplicates and both orientations (the device
     scatter canonicalizes); self-loops are dropped.  ``num_vertices`` bounds
@@ -149,7 +153,7 @@ def pane_triangles_dense(
     _check_k(k)
     n = len(u)
     if n == 0:
-        return 0
+        return None
     cap = max(1, 1 << (n - 1).bit_length())
     uu = np.zeros((cap,), np.int32)
     vv = np.zeros((cap,), np.int32)
@@ -157,6 +161,21 @@ def pane_triangles_dense(
     uu[:n] = u
     vv[:n] = v
     mm[:n] = True if mask is None else mask
-    return _triangles_from_halves(
-        _count_from_edges(uu, vv, mm, k, _use_interpret())
-    )
+    halves = _count_from_edges(uu, vv, mm, k, _use_interpret())
+    try:
+        halves.copy_to_host_async()  # start the readback behind the compute
+    except AttributeError:
+        pass
+    return halves
+
+
+def triangles_from_halves(halves) -> int:
+    """Blocking fetch: device halves (from pane_triangles_submit) -> count."""
+    return 0 if halves is None else _triangles_from_halves(halves)
+
+
+def pane_triangles_dense(
+    u: np.ndarray, v: np.ndarray, num_vertices: int, mask=None
+) -> int:
+    """Synchronous pane count (submit + fetch in one call)."""
+    return triangles_from_halves(pane_triangles_submit(u, v, num_vertices, mask))

@@ -277,37 +277,55 @@ class _DeadlineRunner:
     """Run (potentially hanging) collectives with a wall-clock deadline.
 
     A crashed peer leaves survivors blocked inside the allgather forever —
-    the transport has no side channel.  Calls run on ONE long-lived worker
-    thread (no per-round thread churn on the ingest hot path); exceeding
-    ``timeout`` raises TimeoutError on the caller so the survivor fails fast.
-    After a timeout the worker is considered poisoned (it may never return)
-    and a fresh one is created for any subsequent call; the process is
-    expected to tear down / restart its distributed context on this error.
+    the transport has no side channel.  Calls run on ONE long-lived DAEMON
+    worker thread (no per-round thread churn on the ingest hot path, and —
+    unlike a ThreadPoolExecutor, whose non-daemon workers are joined at
+    interpreter shutdown — an abandoned stuck worker cannot hang a process
+    that is trying to exit after the error).  Exceeding ``timeout`` raises
+    TimeoutError on the caller so the survivor fails fast.  After a timeout
+    the worker is considered poisoned (it may never return) and a fresh one
+    is created for any subsequent call; the process is expected to tear down
+    / restart its distributed context on this error.
     """
 
     def __init__(self):
-        self._pool = None
+        self._chan = None  # (request Queue, response Queue) of the live worker
 
     def run(self, fn: Callable, arg, timeout: Optional[float]):
         if timeout is None:
             return fn(arg)
-        from concurrent.futures import ThreadPoolExecutor
-        from concurrent.futures import TimeoutError as FutureTimeout
+        import queue as _queue
 
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="watermark-deadline"
-            )
-        future = self._pool.submit(fn, arg)
+        if self._chan is None:
+            req: "_queue.Queue" = _queue.Queue()
+            resp: "_queue.Queue" = _queue.Queue()
+
+            def loop():
+                while True:
+                    f, a = req.get()
+                    try:
+                        resp.put((True, f(a)))
+                    except BaseException as e:
+                        resp.put((False, e))
+
+            threading.Thread(
+                target=loop, daemon=True, name="watermark-deadline"
+            ).start()
+            self._chan = (req, resp)
+        req, resp = self._chan
+        req.put((fn, arg))
         try:
-            return future.result(timeout=timeout)
-        except FutureTimeout:
-            self._pool = None  # worker is stuck in the collective: abandon it
+            ok, val = resp.get(timeout=timeout)
+        except _queue.Empty:
+            self._chan = None  # worker is stuck in the collective: abandon it
             raise TimeoutError(
                 f"watermark collective exceeded {timeout}s — peer host "
                 "crashed or wedged; tear down and restart the distributed "
                 "context"
             ) from None
+        if ok:
+            return val
+        raise val
 
 
 def lockstep_tumbling_windows(

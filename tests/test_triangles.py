@@ -112,3 +112,25 @@ def test_block_mode_emits_running_counts():
     assert finals[-1] == 2
     assert finals[2] == 2 and finals[3] == 2  # vertices on both triangles
     assert finals[1] == 1 and finals[4] == 1
+
+
+def test_pipelined_pane_counts_match_sequential():
+    from gelly_streaming_tpu.library.triangles import (
+        _pane_triangle_count,
+        pipelined_pane_counts,
+    )
+    from gelly_streaming_tpu.utils.metrics import WindowLatencyRecorder
+
+    rng = np.random.default_rng(3)
+    panes = [
+        (
+            rng.integers(0, 64, 300).astype(np.int32),
+            rng.integers(0, 64, 300).astype(np.int32),
+        )
+        for _ in range(5)
+    ] + [(np.zeros(0, np.int32), np.zeros(0, np.int32))]
+    rec = WindowLatencyRecorder()
+    piped = pipelined_pane_counts(panes, recorder=rec, warmup=1)
+    seq = [_pane_triangle_count(s, d) for s, d in panes]
+    assert piped == seq
+    assert len(rec.latencies_ms) == len(panes) - 1  # warmup pane unrecorded

@@ -28,15 +28,12 @@ def test_from_arrays_is_wire_eligible():
     cfg = StreamConfig(vertex_capacity=64, batch_size=256)
     stream = EdgeStream.from_arrays(src, dst, cfg)
     agg = ConnectedComponents()
-    assert agg._wire_eligible(stream, checkpoint_path=None)
-    assert not agg._wire_eligible(stream, checkpoint_path="/tmp/x")
+    assert agg._wire_eligible(stream)
     sharded = StreamConfig(vertex_capacity=64, batch_size=256, num_shards=2)
-    assert not agg._wire_eligible(
-        EdgeStream.from_arrays(src, dst, sharded), checkpoint_path=None
-    )
+    assert not agg._wire_eligible(EdgeStream.from_arrays(src, dst, sharded))
     # collection sources have no wire arrays -> simulated path
     coll = EdgeStream.from_collection([(0, 1)], cfg)
-    assert not agg._wire_eligible(coll, checkpoint_path=None)
+    assert not agg._wire_eligible(coll)
 
 
 def test_wire_cc_matches_simulated():
@@ -138,3 +135,65 @@ def test_from_arrays_rejects_out_of_range_ids():
         EdgeStream.from_arrays(
             np.array([2**32 + 5], np.int64), np.array([7], np.int64), cfg
         )
+
+
+def test_wire_ef40_cc_matches_plain():
+    # the sorted multiset encoding must reach the same components as plain
+    src, dst = _random_edges(n=3000, c=64)
+    plain_cfg = StreamConfig(vertex_capacity=64, batch_size=256, wire_encoding="plain")
+    ef_cfg = StreamConfig(vertex_capacity=64, batch_size=256, wire_encoding="ef40")
+    plain = (
+        EdgeStream.from_arrays(src, dst, plain_cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    ef = (
+        EdgeStream.from_arrays(src, dst, ef_cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert plain[0][0].components() == ef[0][0].components()
+
+
+def test_wire_ef40_rejects_order_sensitive_descriptor():
+    import pytest
+
+    from gelly_streaming_tpu.core.aggregation import SummaryBulkAggregation
+
+    class OrderSensitive(SummaryBulkAggregation):  # default order_free=False
+        def initial_state(self, cfg):
+            return np.zeros(())
+
+        def update(self, state, src, dst, val, mask):
+            return state
+
+        def combine(self, a, b):
+            return a
+
+    src, dst = _random_edges(n=64, c=16)
+    cfg = StreamConfig(vertex_capacity=16, batch_size=32, wire_encoding="ef40")
+    with pytest.raises(ValueError, match="order-free"):
+        EdgeStream.from_arrays(src, dst, cfg).aggregate(OrderSensitive()).collect()
+
+
+def test_wire_ef40_bipartiteness_matches_plain():
+    for edges in ([(0, 1), (1, 2), (2, 0)], [(0, 1), (1, 2), (2, 3)]):
+        src = np.array([e[0] for e in edges], np.int32)
+        dst = np.array([e[1] for e in edges], np.int32)
+        plain = (
+            EdgeStream.from_arrays(
+                src, dst, StreamConfig(vertex_capacity=8, batch_size=4)
+            )
+            .aggregate(BipartitenessCheck())
+            .collect()
+        )
+        ef = (
+            EdgeStream.from_arrays(
+                src,
+                dst,
+                StreamConfig(vertex_capacity=8, batch_size=4, wire_encoding="ef40"),
+            )
+            .aggregate(BipartitenessCheck())
+            .collect()
+        )
+        assert str(plain[-1][0]) == str(ef[-1][0])
