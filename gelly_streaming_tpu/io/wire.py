@@ -85,6 +85,15 @@ def _unpack_edges40(wire, n: int):
     return src, dst
 
 
+def wire_nbytes(n: int, width) -> int:
+    """Wire bytes for an n-edge batch at a fixed-width encoding."""
+    if width == PAIR40:
+        return 5 * n
+    if isinstance(width, tuple):  # (EF40, capacity)
+        return ef40_nbytes(n, width[1])
+    return 2 * n * width
+
+
 def ef40_nbytes(n: int, capacity: int) -> int:
     """Wire bytes for an EF40-packed batch of n edges over `capacity` ids."""
     return (n + capacity + 7) // 8 + ((n + 1) // 2) * 5
@@ -219,34 +228,30 @@ def unpack_edges(wire, n: int, width):
     return v[0], v[1]
 
 
-class WirePrefetcher:
-    """Pack + transfer edge batches ahead of the device consumer.
+class Prefetcher:
+    """Prepare + transfer items ahead of the device consumer.
 
-    Wraps an iterator of (src, dst) numpy batches; yields device-resident
-    uint8 wire buffers in order, keeping up to ``depth`` transfers in flight
-    on a background thread.  ``close()`` (or use as a context manager)
-    releases the producer thread and any in-flight buffers if the consumer
-    stops early; exhausting the iterator closes implicitly.
+    Wraps an iterator; for each item a background thread runs
+    ``prepare(item) -> (meta, host_arrays)`` (host-side packing) and
+    ``device_put``s the arrays (a pytree, or None to skip the transfer),
+    yielding ``(meta, device_arrays)`` in order with up to ``depth`` results
+    in flight.  ``close()`` (or use as a context manager) releases the
+    producer thread and any in-flight buffers if the consumer stops early;
+    exhausting the iterator closes implicitly.
     """
 
     _SENTINEL = object()
 
-    def __init__(
-        self,
-        batches: Iterable[Tuple[np.ndarray, np.ndarray]],
-        width: int,
-        device=None,
-        depth: int = 4,
-    ):
+    def __init__(self, items: Iterable, prepare, device=None, depth: int = 4):
         import jax
 
-        self._width = width
+        self._prepare = prepare
         self._device = device if device is not None else jax.devices()[0]
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, args=(iter(batches),), daemon=True
+            target=self._run, args=(iter(items),), daemon=True
         )
         self._thread.start()
 
@@ -260,16 +265,18 @@ class WirePrefetcher:
                 continue
         return False
 
-    def _run(self, it: Iterator[Tuple[np.ndarray, np.ndarray]]):
+    def _run(self, it: Iterator):
         import jax
 
         try:
-            for src, dst in it:
+            for item in it:
                 if self._stop.is_set():
                     return
-                wire = pack_edges(src, dst, self._width)
-                # device_put is async: the DMA overlaps the consumer's compute
-                if not self._put((jax.device_put(wire, self._device), src.shape[0])):
+                meta, host = self._prepare(item)
+                # device_put returns as soon as the transfer is enqueued, so
+                # the next item's packing overlaps the consumer's compute
+                dev = None if host is None else jax.device_put(host, self._device)
+                if not self._put((meta, dev)):
                     return
         except BaseException as e:  # surfaced on the consumer thread
             self._error = e
@@ -303,3 +310,29 @@ class WirePrefetcher:
                 yield item
         finally:
             self.close()
+
+
+class WirePrefetcher(Prefetcher):
+    """Pack + transfer edge batches ahead of the device consumer.
+
+    Wraps an iterator of (src, dst) numpy batches; yields
+    ``(device wire buffer, batch length)`` pairs in order (see Prefetcher for
+    the threading/backpressure contract).
+    """
+
+    def __init__(
+        self,
+        batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+        width,
+        device=None,
+        depth: int = 4,
+    ):
+        def prepare(item):
+            src, dst = item
+            return src.shape[0], pack_edges(src, dst, width)
+
+        super().__init__(batches, prepare, device=device, depth=depth)
+
+    def __iter__(self):
+        for n, buf in super().__iter__():
+            yield buf, n

@@ -80,6 +80,43 @@ def ring_neighbor_features(
     return x_self, mean, cnt
 
 
+def ring_lookup(
+    block: jax.Array,
+    queries: jax.Array,
+    num_shards: int,
+    axis_name: str = SHARD_AXIS,
+):
+    """Answer arbitrary global-id lookups against a modulo-sharded table.
+
+    ``block``: [C/S, ...] this shard's rows of the table (vertex/slot g lives
+    on shard ``g % S`` at row ``g // S``).  ``queries``: [Q] global ids, any
+    owner.  Returns ``table[queries]`` with the table never materialized on
+    one device: the S blocks rotate around the ring (S-1 ``ppermute`` hops)
+    and each visiting block answers the queries it owns.
+
+    This is the capacity-safe alternative to bucketing queries by owner into
+    an ``all_to_all``: a skewed query set (all ids on one shard) would force
+    the bucket capacity to Q per (sender, receiver) pair, an S-fold comm
+    blowup or a drop policy — the ring's cost is a flat C ints per lookup
+    round regardless of the query distribution, and every query is answered.
+    """
+    rows = block.shape[0]
+    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    me = jax.lax.axis_index(axis_name)
+    blk = block
+    ans = jnp.zeros(queries.shape[:1] + block.shape[1:], block.dtype)
+    for t in range(num_shards):
+        owner = jnp.mod(me - t, num_shards)  # whose block is visiting now
+        sel = (queries % num_shards) == owner
+        vals = blk[jnp.clip(queries // num_shards, 0, rows - 1)]
+        ans = jnp.where(
+            sel.reshape(sel.shape + (1,) * (vals.ndim - 1)), vals, ans
+        )
+        if t < num_shards - 1:
+            blk = jax.lax.ppermute(blk, axis_name, perm)
+    return ans
+
+
 def shard_features(features, num_shards: int):
     """[C, F] host features -> [S, C/S, F] modulo-ownership blocks."""
     import numpy as np

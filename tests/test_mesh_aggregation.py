@@ -212,3 +212,73 @@ def test_aggregate_routes_to_mesh_when_sharded():
     base_cfg = _cfg()
     stream2 = EdgeStream.from_collection(_cc_edges(), base_cfg, 2, with_time=True)
     assert outs == [str(o[0]) for o in ConnectedComponents().run(stream2)]
+
+
+def test_mesh_runner_rides_packed_wire_ingest(monkeypatch):
+    """Value-less panes must ship as packed wire rows (not raw int32 buckets),
+    through the pane prefetcher (VERDICT r2 missing #3)."""
+    import gelly_streaming_tpu.core.aggregation as agg_mod
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+
+    rng = np.random.default_rng(9)
+    src = rng.integers(0, 64, 512).astype(np.int32)
+    dst = rng.integers(0, 64, 512).astype(np.int32)
+    cfg = StreamConfig(vertex_capacity=64, batch_size=64, num_shards=8)
+    agg = ConnectedComponents()
+    calls = {"wire": 0, "raw": 0}
+    orig_wire = agg_mod.MeshAggregationRunner._pane_step_wire
+    orig_raw = agg_mod.MeshAggregationRunner._pane_step
+
+    def spy_wire(self, *a, **k):
+        calls["wire"] += 1
+        return orig_wire(self, *a, **k)
+
+    def spy_raw(self, *a, **k):
+        calls["raw"] += 1
+        return orig_raw(self, *a, **k)
+
+    monkeypatch.setattr(agg_mod.MeshAggregationRunner, "_pane_step_wire", spy_wire)
+    monkeypatch.setattr(agg_mod.MeshAggregationRunner, "_pane_step", spy_raw)
+    out = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
+    assert calls["wire"] > 0 and calls["raw"] == 0
+    # and the result still matches the single-shard fast path
+    single = (
+        EdgeStream.from_arrays(
+            src, dst, StreamConfig(vertex_capacity=64, batch_size=64)
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert out[-1][0].components() == single[-1][0].components()
+
+
+def test_mesh_runner_honors_ef40_encoding():
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, 64, 400).astype(np.int32)
+    dst = rng.integers(0, 64, 400).astype(np.int32)
+    plain = (
+        EdgeStream.from_arrays(
+            src,
+            dst,
+            StreamConfig(
+                vertex_capacity=64, batch_size=64, num_shards=8,
+                wire_encoding="plain",
+            ),
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    ef = (
+        EdgeStream.from_arrays(
+            src,
+            dst,
+            StreamConfig(
+                vertex_capacity=64, batch_size=64, num_shards=8,
+                wire_encoding="ef40",
+            ),
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert plain[-1][0].components() == ef[-1][0].components()
