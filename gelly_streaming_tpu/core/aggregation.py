@@ -267,11 +267,20 @@ class SummaryAggregation:
                     # (re-emit from the summary alone) or never completed
                     # (its window position doesn't map to wire batch
                     # positions, so re-fold from the start — exactly-once
-                    # state is preserved either way).
-                    legacy = load_state(
-                        checkpoint_path, self._checkpoint_like(cfg)
-                    )
-                    if bool(legacy["global_done"]) and bool(legacy["has_summary"]):
+                    # state is preserved either way).  The oldest layout — a
+                    # bare summary pytree with no position at all — likewise
+                    # re-folds from the start.
+                    try:
+                        legacy = load_state(
+                            checkpoint_path, self._checkpoint_like(cfg)
+                        )
+                    except ValueError:
+                        legacy = None  # bare-summary snapshot: no position
+                    if (
+                        legacy is not None
+                        and bool(legacy["global_done"])
+                        and bool(legacy["has_summary"])
+                    ):
                         out = self.transform(legacy["summary"])
                         yield out if isinstance(out, tuple) else (out,)
                         return

@@ -50,12 +50,36 @@ _build_buckets_j = jax.jit(nbh_ops.build_buckets)
 
 
 class SnapshotStream:
-    """Windowed graph-snapshot stream (reference: SnapshotStream.java:46)."""
+    """Windowed graph-snapshot stream (reference: SnapshotStream.java:46).
+
+    With ``cfg.num_shards > 1`` (and enough devices) the aggregations run on
+    the sharded data plane: each pane's edges route to their key's owner
+    shard (the keyBy shuffle — slice() is a *distributed* keyed window,
+    SimpleEdgeStream.java:149-163), every shard builds its own degree-
+    bucketed neighborhoods on device and runs the user kernel over them
+    inside ONE shard_map step — keys are partitioned, so no collective is
+    needed past the route, exactly like the reference's keyed window
+    operator.
+    """
 
     def __init__(self, edge_stream, window_ms: int, direction: EdgeDirection):
         self._stream = edge_stream
         self.window_ms = window_ms
         self.direction = direction
+
+    def _directed_edges(self, pane: WindowPane):
+        """(src, dst, val) with slice()'s direction semantics applied."""
+        src, dst, val = pane.src, pane.dst, pane.val
+        if self.direction == EdgeDirection.IN:
+            src, dst = dst, src
+        elif self.direction == EdgeDirection.ALL:
+            src, dst = (
+                np.concatenate([src, dst]),
+                np.concatenate([dst, src]),
+            )
+            if val is not None:
+                val = jax.tree.map(lambda a: np.concatenate([a, a]), val)
+        return src, dst, val
 
     def _neighborhood_panes(self) -> Iterator[Neighborhoods]:
         """Device-built, degree-bucketed neighborhoods per closed pane.
@@ -67,16 +91,7 @@ class SnapshotStream:
         """
         panes = assign_tumbling_windows(self._stream.batches(), self.window_ms)
         for pane in panes:
-            src, dst, val = pane.src, pane.dst, pane.val
-            if self.direction == EdgeDirection.IN:
-                src, dst = dst, src
-            elif self.direction == EdgeDirection.ALL:
-                src, dst = (
-                    np.concatenate([src, dst]),
-                    np.concatenate([dst, src]),
-                )
-                if val is not None:
-                    val = jax.tree.map(lambda a: np.concatenate([a, a]), val)
+            src, dst, val = self._directed_edges(pane)
             n = len(src)
             if n == 0:
                 continue
@@ -103,6 +118,152 @@ class SnapshotStream:
                     pane, bkt.keys, bkt.nbrs, bkt.vals, bkt.valid, nk
                 )
 
+    # ---- kernel execution (single-device and sharded) -----------------------
+
+    def _use_mesh(self) -> bool:
+        cfg = self._stream.cfg
+        return cfg.num_shards > 1 and cfg.num_shards <= len(jax.devices())
+
+    def _kernel_chunks(self, bucket_kernel, needs_vals: bool, extra=None):
+        """Run ``bucket_kernel(keys, nbrs, vals, valid[, extra])`` over every
+        neighborhood bucket; yield host chunks
+        ``(window_id, keys [n], out pytree of [n, ...], n)`` of real rows.
+
+        ``extra`` is an optional per-shard operand pytree with leading shard
+        axis ([S, ...] — e.g. ring feature blocks); on the single-device path
+        its [0] slice is passed.
+        """
+        if self._use_mesh():
+            yield from self._kernel_chunks_mesh(bucket_kernel, needs_vals, extra)
+            return
+        if extra is None:
+            kernel = jax.jit(bucket_kernel)
+        else:
+            x0 = jax.tree.map(lambda a: a[0], extra)
+            kernel = jax.jit(
+                lambda k, nb, v, vd: bucket_kernel(k, nb, v, vd, x0)
+            )
+        for hood in self._neighborhood_panes():
+            if needs_vals and hood.vals is None:
+                raise ValueError(
+                    "this aggregation requires edge values; the stream has none"
+                )
+            out = kernel(
+                jnp.asarray(hood.keys),
+                jnp.asarray(hood.nbrs),
+                jax.tree.map(jnp.asarray, hood.vals),
+                jnp.asarray(hood.valid),
+            )
+            n = hood.num_keys
+            yield (
+                hood.pane.window_id,
+                np.asarray(hood.keys)[:n],
+                jax.tree.map(lambda a: np.asarray(a)[:n], out),
+                n,
+            )
+
+    def _mesh_step(self, cache, bucket_kernel, cap, has_val, extra_proto):
+        key = (cap, has_val)
+        if key in cache:
+            return cache[key]
+        from jax.sharding import PartitionSpec as P
+
+        from gelly_streaming_tpu.parallel.mesh import make_mesh, shard_map
+
+        cfg = self._stream.cfg
+        mesh = make_mesh(cfg.num_shards)
+
+        def step(src, dst, val, mask, extra):
+            b_val = None if val is None else jax.tree.map(lambda a: a[0], val)
+            x = None if extra is None else jax.tree.map(lambda a: a[0], extra)
+            buckets = nbh_ops.build_buckets(src[0], dst[0], b_val, mask[0])
+            outs = []
+            for b in buckets:
+                out = (
+                    bucket_kernel(b.keys, b.nbrs, b.vals, b.valid)
+                    if x is None
+                    else bucket_kernel(b.keys, b.nbrs, b.vals, b.valid, x)
+                )
+                outs.append((b.keys, out, b.num_keys.reshape(1)))
+            return tuple(outs)
+
+        spec = P("shards")
+        fn = jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(
+                    spec,
+                    spec,
+                    spec if has_val else None,
+                    spec,
+                    None if extra_proto is None else spec,
+                ),
+                out_specs=spec,
+            )
+        )
+        cache[key] = fn
+        return fn
+
+    def _kernel_chunks_mesh(self, bucket_kernel, needs_vals: bool, extra=None):
+        """The sharded plane: host keyBy route -> per-shard device bucket
+        build + kernel inside shard_map -> host chunks per (bucket, shard)."""
+        from gelly_streaming_tpu.parallel.routing import host_route
+
+        cfg = self._stream.cfg
+        s_n = cfg.num_shards
+        cache: dict = {}
+        panes = assign_tumbling_windows(self._stream.batches(), self.window_ms)
+        for pane in panes:
+            src, dst, val = self._directed_edges(pane)
+            if len(src) == 0:
+                continue
+            if needs_vals and val is None:
+                raise ValueError(
+                    "this aggregation requires edge values; the stream has none"
+                )
+            counts = np.bincount(src % s_n, minlength=s_n)
+            cap = max(1, 1 << (int(counts.max()) - 1).bit_length())
+            routed = host_route(
+                src.astype(np.int32),
+                dst.astype(np.int32),
+                s_n,
+                key="src",
+                capacity=cap,
+                val=val,
+            )
+            step = self._mesh_step(
+                cache, bucket_kernel, cap, routed.val is not None, extra
+            )
+            outs = step(
+                jnp.asarray(routed.src),
+                jnp.asarray(routed.dst),
+                None
+                if routed.val is None
+                else jax.tree.map(jnp.asarray, routed.val),
+                jnp.asarray(routed.mask),
+                extra,
+            )
+            for (keys_g, out_g, num_g), (k_b, _) in zip(
+                outs, nbh_ops.bucket_shapes(cap)
+            ):
+                num_h = np.asarray(num_g)
+                if not num_h.any():
+                    continue
+                keys_h = np.asarray(keys_g)
+                out_h = jax.tree.map(np.asarray, out_g)
+                for s in range(s_n):
+                    n = int(num_h[s])
+                    if n == 0:
+                        continue
+                    sl = slice(s * k_b, s * k_b + n)
+                    yield (
+                        pane.window_id,
+                        keys_h[sl],
+                        jax.tree.map(lambda a: a[sl], out_h),
+                        n,
+                    )
+
     # ---- aggregations -------------------------------------------------------
 
     def fold_neighbors(self, init_accum, fold_fn: Callable) -> OutputStream:
@@ -127,19 +288,11 @@ class SnapshotStream:
 
             return jax.vmap(per_key)(keys, nbrs, vals, valid)
 
-        kernel = jax.jit(kernel)
-
         def records():
-            for hood in self._neighborhood_panes():
-                accums = kernel(
-                    jnp.asarray(hood.keys),
-                    jnp.asarray(hood.nbrs),
-                    jax.tree.map(jnp.asarray, hood.vals),
-                    jnp.asarray(hood.valid),
-                )
-                leaves = [np.asarray(x) for x in jax.tree.leaves(accums)]
-                treedef = jax.tree.structure(accums)
-                for i in range(hood.num_keys):
+            for _, keys_h, out, n in self._kernel_chunks(kernel, False):
+                leaves = jax.tree.leaves(out)
+                treedef = jax.tree.structure(out)
+                for i in range(n):
                     rec = jax.tree.unflatten(
                         treedef, [leaf[i].item() for leaf in leaves]
                     )
@@ -177,24 +330,11 @@ class SnapshotStream:
 
             return jax.vmap(per_key)(keys, vals, valid)
 
-        kernel = jax.jit(kernel)
-
         def records():
-            for hood in self._neighborhood_panes():
-                if hood.vals is None:
-                    raise ValueError(
-                        "reduce_on_edges requires edge values; this stream has none"
-                    )
-                out = kernel(
-                    jnp.asarray(hood.keys),
-                    jnp.asarray(hood.nbrs),
-                    jax.tree.map(jnp.asarray, hood.vals),
-                    jnp.asarray(hood.valid),
-                )
-                leaves = [np.asarray(x) for x in jax.tree.leaves(out)]
+            for _, keys_h, out, n in self._kernel_chunks(kernel, True):
+                leaves = jax.tree.leaves(out)
                 treedef = jax.tree.structure(out)
-                keys_h = np.asarray(hood.keys)
-                for i in range(hood.num_keys):
+                for i in range(n):
                     rec = jax.tree.unflatten(
                         treedef, [leaf[i].item() for leaf in leaves]
                     )
@@ -214,19 +354,11 @@ class SnapshotStream:
         def kernel(keys, nbrs, vals, valid):
             return jax.vmap(apply_fn)(keys, nbrs, vals, valid)
 
-        kernel = jax.jit(kernel)
-
         def records():
-            for hood in self._neighborhood_panes():
-                out = kernel(
-                    jnp.asarray(hood.keys),
-                    jnp.asarray(hood.nbrs),
-                    jax.tree.map(jnp.asarray, hood.vals),
-                    jnp.asarray(hood.valid),
-                )
-                leaves = [np.asarray(x) for x in jax.tree.leaves(out)]
+            for _, keys_h, out, n in self._kernel_chunks(kernel, False):
+                leaves = jax.tree.leaves(out)
                 treedef = jax.tree.structure(out)
-                for i in range(hood.num_keys):
+                for i in range(n):
                     rec = jax.tree.unflatten(
                         treedef, [leaf[i].item() for leaf in leaves]
                     )

@@ -246,7 +246,16 @@ class BlockShardedCC:
         window_ms = self.window_ms or cfg.window_ms
 
         def records():
-            label = jnp.asarray(init_label_blocks(cfg.vertex_capacity, n))
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            # block-distributed from the first byte: the [S, C/S] table goes
+            # straight to its owners (committing it to one device first would
+            # reintroduce the O(C)-per-chip footprint this class removes)
+            label = jax.device_put(
+                init_label_blocks(cfg.vertex_capacity, n),
+                NamedSharding(self.mesh, P(SHARD_AXIS)),
+            )
             for pane in assign_tumbling_windows(stream.batches(), window_ms):
                 if len(pane.src) == 0:
                     continue

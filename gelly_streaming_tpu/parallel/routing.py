@@ -30,6 +30,7 @@ class RoutedEdges(NamedTuple):
     src: np.ndarray  # [S, B]
     dst: np.ndarray  # [S, B]
     mask: np.ndarray  # [S, B]
+    val: Optional[object] = None  # pytree of [S, B, ...] or None
 
 
 def host_route(
@@ -38,22 +39,38 @@ def host_route(
     num_shards: int,
     key: str = "src",
     capacity: Optional[int] = None,
+    val=None,
 ) -> RoutedEdges:
     """Bucket edges by owner shard on the host, padding each bucket to a common
-    capacity.  ``key`` picks the routing key ("src" or "dst")."""
+    capacity.  ``key`` picks the routing key ("src" or "dst"); an optional
+    ``val`` pytree of per-edge payloads routes alongside the ids.  Relative
+    edge order is preserved within each shard (boolean-mask selection), so
+    per-key arrival-order semantics survive the shuffle."""
     owner = (src if key == "src" else dst) % num_shards
     counts = np.bincount(owner, minlength=num_shards)
     cap = capacity or (int(counts.max()) if len(src) else 1)
     s = np.zeros((num_shards, cap), np.int32)
     d = np.zeros((num_shards, cap), np.int32)
     m = np.zeros((num_shards, cap), bool)
+    v = None
+    if val is not None:
+        v = jax.tree.map(
+            lambda a: np.zeros((num_shards, cap) + a.shape[1:], a.dtype), val
+        )
     for shard in range(num_shards):
         sel = owner == shard
         n = min(int(sel.sum()), cap)
         s[shard, :n] = src[sel][:n]
         d[shard, :n] = dst[sel][:n]
         m[shard, :n] = True
-    return RoutedEdges(s, d, m)
+        if v is not None:
+
+            def fill(buf, a):
+                buf[shard, :n] = a[sel][:n]
+                return buf
+
+            v = jax.tree.map(fill, v, val)
+    return RoutedEdges(s, d, m, v)
 
 
 def device_route(

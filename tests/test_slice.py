@@ -90,3 +90,64 @@ def test_slice_multi_batch_single_window():
         .reduce_on_edges(_reduce)
     )
     assert_lines(out.lines(), FOLD_OUT)
+
+
+# ---------------------------------------------------------------------------
+# Sharded path: all nine combinations again through the 8-device mesh
+# (VERDICT r2 missing #5 — slice() is a distributed keyed window,
+# SimpleEdgeStream.java:149-163)
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from fixtures import LONG_LONG_EDGES
+from gelly_streaming_tpu.core.stream import EdgeStream
+
+SHARDED_CFG = StreamConfig(
+    vertex_capacity=16, max_degree=16, batch_size=4, num_shards=8
+)
+
+
+def _sharded_stream():
+    return EdgeStream.from_collection(LONG_LONG_EDGES, SHARDED_CFG, batch_size=4)
+
+
+@pytest.mark.parametrize(
+    "direction,golden",
+    [
+        (EdgeDirection.OUT, FOLD_OUT),
+        (EdgeDirection.IN, FOLD_IN),
+        (EdgeDirection.ALL, FOLD_ALL),
+    ],
+)
+def test_fold_neighbors_sharded(direction, golden):
+    out = _sharded_stream().slice(1000, direction).fold_neighbors((0, 0), _fold)
+    assert_lines(out.lines(), golden)
+
+
+@pytest.mark.parametrize(
+    "direction,golden",
+    [
+        (EdgeDirection.OUT, FOLD_OUT),
+        (EdgeDirection.IN, FOLD_IN),
+        (EdgeDirection.ALL, FOLD_ALL),
+    ],
+)
+def test_reduce_on_edges_sharded(direction, golden):
+    out = _sharded_stream().slice(1000, direction).reduce_on_edges(_reduce)
+    assert_lines(out.lines(), golden)
+
+
+@pytest.mark.parametrize(
+    "direction,golden",
+    [
+        (EdgeDirection.OUT, APPLY_OUT),
+        (EdgeDirection.IN, APPLY_IN),
+        (EdgeDirection.ALL, APPLY_ALL),
+    ],
+)
+def test_apply_on_neighbors_sharded(direction, golden):
+    out = (
+        _sharded_stream()
+        .slice(1000, direction)
+        .apply_on_neighbors(_apply, post=_post)
+    )
+    assert_lines(out.lines(), golden)
