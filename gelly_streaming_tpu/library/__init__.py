@@ -2,10 +2,13 @@
 
 from gelly_streaming_tpu.library.bipartiteness import BipartitenessCheck
 from gelly_streaming_tpu.library.connected_components import (
+    BlockShardedCC,
     ConnectedComponents,
     ConnectedComponentsTree,
+    block_sharded_cc_fixpoint,
     sharded_cc_fixpoint,
     sharded_cc_round,
+    unshard_labels,
 )
 from gelly_streaming_tpu.library.degree_distribution import DegreeDistribution
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
@@ -19,12 +22,19 @@ from gelly_streaming_tpu.library.sampled_triangles import (
     IncidenceSamplingTriangleCount,
 )
 from gelly_streaming_tpu.library.spanner import Spanner
-from gelly_streaming_tpu.library.triangles import ExactTriangleCount, window_triangles
+from gelly_streaming_tpu.library.triangles import (
+    ExactTriangleCount,
+    pipelined_pane_counts,
+    window_triangles,
+)
 
 __all__ = [
     "BipartitenessCheck",
+    "BlockShardedCC",
     "ConnectedComponents",
     "ConnectedComponentsTree",
+    "block_sharded_cc_fixpoint",
+    "unshard_labels",
     "sharded_cc_fixpoint",
     "sharded_cc_round",
     "DegreeDistribution",
@@ -36,5 +46,6 @@ __all__ = [
     "MeshSampledTriangleCount",
     "Spanner",
     "ExactTriangleCount",
+    "pipelined_pane_counts",
     "window_triangles",
 ]
