@@ -221,61 +221,62 @@ int64_t pack_edges40(const int32_t* src, const int32_t* dst, int64_t n,
   return q - out;
 }
 
-// Elias-Fano pack of a sorted edge batch for vertex spaces up to 2^20 — the
-// "order-free" wire mode: when the consumer's fold is order-insensitive (e.g.
-// streaming CC union), the host may sort the micro-batch and ship only the
-// multiset.  Layout: sort w = (src<<20)|dst ascending; the high 20 bits (src)
-// become a unary histogram bitvector of n + capacity bits (count[v] ones then
-// a zero per vertex; the i-th one sits at position src_i + i), the low 20 bits
-// (dst) pack two-per-5-bytes as in pack_edges40.  Total (n+cap)/8 + 2.5n
-// bytes ~= 2.6-2.9 B/edge vs 5 — worth it when host cores are plentiful; on a
-// single-core host the radix sort competes with the transfer for CPU and the
-// plain 40-bit pack wins (io/wire.py documents the measured tradeoff).
+// Elias-Fano pack of a src-GROUPED edge batch for vertex spaces up to 2^20 —
+// the "order-free" wire mode: when the consumer's fold is order-insensitive
+// (e.g. streaming CC union), the host may regroup the micro-batch and ship
+// only the multiset.  Layout: a unary src histogram bitvector of n + capacity
+// bits (count[v] ones then a zero per vertex) followed by the dst ids in
+// src-grouped order (stable within a group), packed 20-bit two-per-5-bytes as
+// in pack_edges40.  A full (src, dst) sort is NOT needed: the decoder pairs
+// the i-th low with the i-th unary one, so any dst order within a src group
+// decodes to the same multiset — which is why the pack is a counting sort by
+// src (3 linear passes, no 64-bit keys) instead of a radix sort.  Total
+// (n+cap)/8 + 2.5n bytes ~= 2.6-2.9 B/edge vs 5 — worth it when host cores
+// are plentiful; on a single-core host even this pack competes with the
+// transfer for CPU and the plain 40-bit pack wins (io/wire.py documents the
+// measured tradeoff).
 int64_t pack_edges_ef40(const int32_t* src, const int32_t* dst, int64_t n,
                         int32_t capacity, uint8_t* out, int64_t out_cap) {
   if (capacity <= 0 || capacity > (1 << 20) || n < 0) return -1;
   int64_t bvbytes = (n + capacity + 7) / 8;
   int64_t lowbytes = ((n + 1) / 2) * 5;
   if (out_cap < bvbytes + lowbytes) return -1;
-  uint64_t* a = static_cast<uint64_t*>(malloc(n * 8));
-  uint64_t* b = static_cast<uint64_t*>(malloc(n * 8));
-  if (!a || !b) {
-    free(a);
-    free(b);
+  uint32_t* off = static_cast<uint32_t*>(calloc(capacity + 1, 4));
+  uint32_t* lows = static_cast<uint32_t*>(malloc((n + 1) * 4));
+  if (!off || !lows) {
+    free(off);
+    free(lows);
     return -1;
   }
-  for (int64_t i = 0; i < n; ++i) {
-    a[i] = (static_cast<uint64_t>(static_cast<uint32_t>(src[i]) & 0xFFFFF)
-            << 20) |
-           (static_cast<uint32_t>(dst[i]) & 0xFFFFF);
-  }
-  // LSD radix over the 40-bit key: 4 passes of 10 bits (1K-entry histogram
-  // stays L1-resident)
-  static thread_local int64_t hist[1024];
-  for (int pass = 0; pass < 4; ++pass) {
-    int shift = pass * 10;
-    memset(hist, 0, sizeof hist);
-    for (int64_t i = 0; i < n; ++i) hist[(a[i] >> shift) & 1023]++;
-    int64_t sum = 0;
-    for (int k = 0; k < 1024; ++k) {
-      int64_t c = hist[k];
-      hist[k] = sum;
+  for (int64_t i = 0; i < n; ++i) off[(uint32_t)src[i] & 0xFFFFF]++;
+  // exclusive prefix -> group offsets
+  {
+    uint32_t sum = 0;
+    for (int32_t v = 0; v <= capacity; ++v) {
+      uint32_t c = (v < capacity) ? off[v] : 0;
+      off[v] = sum;
       sum += c;
     }
-    for (int64_t i = 0; i < n; ++i) b[hist[(a[i] >> shift) & 1023]++] = a[i];
-    uint64_t* t = a;
-    a = b;
-    b = t;
   }
-  memset(out, 0, bvbytes);
+  // unary bitvector from the offsets: all ones, then clear each group's
+  // terminating zero (cap single-bit clears instead of n bit-by-bit sets)
+  memset(out, 0xFF, bvbytes);
+  for (int32_t v = 0; v < capacity; ++v) {
+    int64_t p = (int64_t)off[v + 1] + v;  // ones before the zero + prior zeros
+    out[p >> 3] &= static_cast<uint8_t>(~(1u << (p & 7)));
+  }
+  // trailing pad bits of the last byte must be zero (byte parity with the
+  // numpy packbits fallback; the decoder ignores them either way)
+  for (int64_t p = n + capacity; p < bvbytes * 8; ++p) {
+    out[p >> 3] &= static_cast<uint8_t>(~(1u << (p & 7)));
+  }
   for (int64_t i = 0; i < n; ++i) {
-    int64_t p = static_cast<int64_t>(a[i] >> 20) + i;  // src rank + row rank
-    out[p >> 3] |= static_cast<uint8_t>(1u << (p & 7));
+    lows[off[(uint32_t)src[i] & 0xFFFFF]++] = (uint32_t)dst[i] & 0xFFFFF;
   }
+  lows[n] = 0;  // pad partner for odd n
   uint8_t* q = out + bvbytes;
-  int64_t i = 0;
-  for (; i + 1 < n; i += 2) {
-    uint64_t w = (a[i] & 0xFFFFF) | ((a[i + 1] & 0xFFFFF) << 20);
+  for (int64_t i = 0; i < n; i += 2) {
+    uint64_t w = (uint64_t)lows[i] | ((uint64_t)lows[i + 1] << 20);
     q[0] = w & 0xFF;
     q[1] = (w >> 8) & 0xFF;
     q[2] = (w >> 16) & 0xFF;
@@ -283,17 +284,8 @@ int64_t pack_edges_ef40(const int32_t* src, const int32_t* dst, int64_t n,
     q[4] = (w >> 32) & 0xFF;
     q += 5;
   }
-  if (i < n) {
-    uint64_t w = a[i] & 0xFFFFF;
-    q[0] = w & 0xFF;
-    q[1] = (w >> 8) & 0xFF;
-    q[2] = (w >> 16) & 0xFF;
-    q[3] = 0;
-    q[4] = 0;
-    q += 5;
-  }
-  free(a);
-  free(b);
+  free(off);
+  free(lows);
   return q - out;
 }
 
