@@ -128,6 +128,38 @@ def _triangle_latency(seed: int = 0, windows: int = 7, k: int = 4096):
     return rec.percentile(50), rec.percentile(95)
 
 
+def _init_watchdog(seconds: float):
+    """Fail fast with an explainable JSON line if device-backend init wedges.
+
+    The session tunnel's client creation can hang indefinitely when the
+    tunnel service is down (observed round 3); without this the driver's
+    bench run would block forever with no artifact.  Returns a cancel()."""
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(seconds):
+            print(
+                json.dumps(
+                    {
+                        "error": "device backend init exceeded "
+                        f"{seconds:.0f}s — tunnel down or wedged; no "
+                        "throughput measured",
+                        "metric": "streaming_cc_edges_per_sec",
+                        "value": None,
+                        "unit": "edges/s",
+                        "vs_baseline": None,
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done.set
+
+
 def main():
     num_edges = int(os.environ.get("GELLY_BENCH_EDGES", 1 << 24))
     capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
@@ -138,6 +170,9 @@ def main():
     trials = max(1, int(os.environ.get("GELLY_BENCH_TRIALS", 3)))
     settle = float(os.environ.get("GELLY_BENCH_SETTLE", 12.0))
 
+    cancel_watchdog = _init_watchdog(
+        float(os.environ.get("GELLY_BENCH_INIT_TIMEOUT", 600))
+    )
     import jax
 
     from gelly_streaming_tpu.core.config import StreamConfig
@@ -146,6 +181,9 @@ def main():
     from gelly_streaming_tpu.library.connected_components import ConnectedComponents
     from gelly_streaming_tpu.ops import unionfind as uf
     from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+    jax.devices()  # force backend init under the watchdog
+    cancel_watchdog()
 
     rng = np.random.default_rng(0)
     src = rng.integers(0, capacity, num_edges).astype(np.int32)

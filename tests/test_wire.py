@@ -197,3 +197,21 @@ def test_ef40_odd_and_duplicate_edges():
 def test_ef40_bytes_beat_pair40_at_scale():
     n, cap = 1 << 16, 1 << 16
     assert wire.ef40_nbytes(n, cap) < 5 * n * 0.6  # < 3 B/edge here
+
+
+def test_records48_roundtrip():
+    import jax
+
+    rng = np.random.default_rng(17)
+    ids = rng.integers(0, 1 << 20, 1000).astype(np.int32)
+    vals = rng.integers(0, 1 << 28, 1000).astype(np.int32)
+    mask = rng.random(1000) < 0.7
+    import jax.numpy as jnp
+
+    packed = jax.jit(wire.pack_records48)(jnp.asarray(ids), jnp.asarray(vals))
+    bits = jax.jit(wire.pack_mask_bits)(jnp.asarray(mask))
+    assert packed.shape == (6000,) and bits.shape == (125,)
+    i2, v2, m2 = wire.unpack_records48(np.asarray(packed), np.asarray(bits), 1000)
+    np.testing.assert_array_equal(i2, ids)
+    np.testing.assert_array_equal(v2, vals)
+    np.testing.assert_array_equal(m2, mask)
