@@ -217,6 +217,49 @@ class SummaryAggregation:
             "done": np.zeros((), bool),
         }
 
+    def _wire_restore(self, stream, checkpoint_path: Optional[str], batch: int):
+        """Resolve a wire-path snapshot into a resume plan.
+
+        Returns ``(start_batch, carry_host, done_summary)``: the batch to
+        resume folding from, the restored carry (or None for a fresh start),
+        and — when the snapshot says the stream already finished — the
+        summary to re-emit instead of folding.  Legacy layouts degrade
+        gracefully: a windowed-loop snapshot whose global pane finished
+        re-emits; any other legacy form (windowed not-done, or the oldest
+        bare-summary pytree) re-folds from the start — window positions
+        don't map to wire batch positions, and exactly-once state holds
+        either way.
+        """
+        cfg = stream.cfg
+        if not checkpoint_path:
+            return 0, None, None
+        from gelly_streaming_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_state,
+        )
+
+        if not checkpoint_exists(checkpoint_path):
+            return 0, None, None
+        try:
+            snap = load_state(checkpoint_path, self._wire_checkpoint_like(stream))
+        except ValueError:  # a pre-wire-path (windowed-layout) snapshot
+            try:
+                legacy = load_state(checkpoint_path, self._checkpoint_like(cfg))
+            except ValueError:
+                return 0, None, None  # bare-summary snapshot: no position
+            if bool(legacy["global_done"]) and bool(legacy["has_summary"]):
+                return 0, None, legacy["summary"]
+            return 0, None, None
+        if int(snap["batch"]) != batch:
+            raise ValueError(
+                f"wire checkpoint was written with batch_size "
+                f"{int(snap['batch'])}; resuming with {batch} would "
+                "misalign the stream position"
+            )
+        if bool(snap["done"]):
+            return 0, None, snap["summary"]
+        return int(snap["next_batch"]), (snap["stages"], snap["summary"]), None
+
     def _wire_records(
         self,
         stream,
@@ -245,60 +288,15 @@ class SummaryAggregation:
         width = self._wire_width(cfg)
         fused, tail = self._wire_fused_step(stream, batch, width)
         n_full = len(src) // batch
-        start_batch = 0
-        carry_host = None
-        if checkpoint_path and restore:
-            from gelly_streaming_tpu.utils.checkpoint import (
-                checkpoint_exists,
-                load_state,
-            )
-
-            if checkpoint_exists(checkpoint_path):
-                try:
-                    snap = load_state(
-                        checkpoint_path, self._wire_checkpoint_like(stream)
-                    )
-                except ValueError:
-                    snap = None  # a pre-wire-path (windowed-layout) snapshot
-                if snap is None:
-                    # Legacy layout from the windowed merge loop (this stream
-                    # ran the simulated path before the wire path learned to
-                    # checkpoint): its single global pane either finished
-                    # (re-emit from the summary alone) or never completed
-                    # (its window position doesn't map to wire batch
-                    # positions, so re-fold from the start — exactly-once
-                    # state is preserved either way).  The oldest layout — a
-                    # bare summary pytree with no position at all — likewise
-                    # re-folds from the start.
-                    try:
-                        legacy = load_state(
-                            checkpoint_path, self._checkpoint_like(cfg)
-                        )
-                    except ValueError:
-                        legacy = None  # bare-summary snapshot: no position
-                    if (
-                        legacy is not None
-                        and bool(legacy["global_done"])
-                        and bool(legacy["has_summary"])
-                    ):
-                        out = self.transform(legacy["summary"])
-                        yield out if isinstance(out, tuple) else (out,)
-                        return
-                else:
-                    if int(snap["batch"]) != batch:
-                        raise ValueError(
-                            f"wire checkpoint was written with batch_size "
-                            f"{int(snap['batch'])}; resuming with {batch} "
-                            "would misalign the stream position"
-                        )
-                    if bool(snap["done"]):
-                        # stream fully folded before the crash: re-emit (the
-                        # at-least-once contract) without re-folding
-                        out = self.transform(snap["summary"])
-                        yield out if isinstance(out, tuple) else (out,)
-                        return
-                    start_batch = int(snap["next_batch"])
-                    carry_host = (snap["stages"], snap["summary"])
+        start_batch, carry_host, done_summary = self._wire_restore(
+            stream, checkpoint_path if restore else None, batch
+        )
+        if done_summary is not None:
+            # stream fully folded before the crash: re-emit (the
+            # at-least-once contract) without re-folding
+            out = self.transform(done_summary)
+            yield out if isinstance(out, tuple) else (out,)
+            return
         # committed placement so the first and later calls share one jit entry
         carry = jax.device_put(
             carry_host

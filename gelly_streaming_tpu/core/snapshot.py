@@ -124,6 +124,28 @@ class SnapshotStream:
         cfg = self._stream.cfg
         return cfg.num_shards > 1 and cfg.num_shards <= len(jax.devices())
 
+    def _kernel_cache(self, bucket_kernel) -> dict:
+        """Per-kernel compiled-fn cache, surviving OutputStream re-runs.
+
+        Keyed on the kernel closure (one per aggregation call, shared by
+        every re-run of that call's OutputStream), holding the jitted
+        single-device fn and the per-(cap, has_val) mesh steps — so
+        re-running a stream never recompiles.  Bounded with oldest-first
+        eviction (compiled fns are heavy; same policy as the aggregate
+        path's `_wire_fused_step` cache).  A kernel is always paired with
+        the same ``extra`` operand by its creator, so extra need not key
+        the cache.
+        """
+        if not hasattr(self, "_kernel_caches"):
+            self._kernel_caches = {}
+        entry = self._kernel_caches.get(bucket_kernel)
+        if entry is None:
+            while len(self._kernel_caches) >= 8:
+                self._kernel_caches.pop(next(iter(self._kernel_caches)))
+            entry = {}
+            self._kernel_caches[bucket_kernel] = entry
+        return entry
+
     def _kernel_chunks(self, bucket_kernel, needs_vals: bool, extra=None):
         """Run ``bucket_kernel(keys, nbrs, vals, valid[, extra])`` over every
         neighborhood bucket; yield host chunks
@@ -136,13 +158,17 @@ class SnapshotStream:
         if self._use_mesh():
             yield from self._kernel_chunks_mesh(bucket_kernel, needs_vals, extra)
             return
-        if extra is None:
-            kernel = jax.jit(bucket_kernel)
-        else:
-            x0 = jax.tree.map(lambda a: a[0], extra)
-            kernel = jax.jit(
-                lambda k, nb, v, vd: bucket_kernel(k, nb, v, vd, x0)
-            )
+        cache = self._kernel_cache(bucket_kernel)
+        kernel = cache.get("jit")
+        if kernel is None:
+            if extra is None:
+                kernel = jax.jit(bucket_kernel)
+            else:
+                x0 = jax.tree.map(lambda a: a[0], extra)
+                kernel = jax.jit(
+                    lambda k, nb, v, vd: bucket_kernel(k, nb, v, vd, x0)
+                )
+            cache["jit"] = kernel
         for hood in self._neighborhood_panes():
             if needs_vals and hood.vals is None:
                 raise ValueError(
@@ -212,7 +238,7 @@ class SnapshotStream:
 
         cfg = self._stream.cfg
         s_n = cfg.num_shards
-        cache: dict = {}
+        cache = self._kernel_cache(bucket_kernel)
         panes = assign_tumbling_windows(self._stream.batches(), self.window_ms)
         for pane in panes:
             src, dst, val = self._directed_edges(pane)

@@ -123,16 +123,20 @@ class GraphSAGEWindows:
                 es.append(np.asarray(emb.astype(jnp.float32))[:n])
             yield np.concatenate(ks), np.concatenate(es)
 
-    def _run_sharded(self, snapshot: SnapshotStream):
-        """Ring-sharded window pass: feature blocks [S, C/S, F] stay on their
-        shards; each shard's buckets gather remote rows via ppermute hops."""
+    def _sharded_state(self, s_n: int):
+        """(kernel, blocks) built once per shard count: the kernel object is
+        the snapshot layer's compile-cache key, so re-running the
+        OutputStream (or a new window pass) must present the SAME closure —
+        and the block placement should happen once, not per run."""
+        cached = getattr(self, "_sharded_cache", None)
+        if cached is not None and cached[0] == s_n:
+            return cached[1], cached[2]
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS, make_mesh
         from gelly_streaming_tpu.parallel.ring import shard_features
 
-        s_n = snapshot._stream.cfg.num_shards
         # place each block on its shard up front: the table must never sit
         # whole on one device (that replication is what the ring avoids)
         blocks = jax.device_put(
@@ -143,6 +147,14 @@ class GraphSAGEWindows:
 
         def kernel(keys, nbrs, vals, valid, block):
             return sage_kernel_ring(params, block, keys, nbrs, valid, s_n)
+
+        self._sharded_cache = (s_n, kernel, blocks)
+        return kernel, blocks
+
+    def _run_sharded(self, snapshot: SnapshotStream):
+        """Ring-sharded window pass: feature blocks [S, C/S, F] stay on their
+        shards; each shard's buckets gather remote rows via ppermute hops."""
+        kernel, blocks = self._sharded_state(snapshot._stream.cfg.num_shards)
 
         cur_wid = None
         ks, es = [], []
