@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gelly_streaming_tpu.core.config import StreamConfig
-from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.ops import unionfind as uf
 
 
@@ -38,7 +38,7 @@ class IterativeConnectedComponents:
     def run(self, stream) -> OutputStream:
         cfg = stream.cfg
 
-        def records():
+        def blocks():
             parent = uf.init_parent(cfg.vertex_capacity)
             seen = jnp.zeros((cfg.vertex_capacity,), bool)
             prev = np.asarray(parent).copy()
@@ -50,11 +50,13 @@ class IterativeConnectedComponents:
                 p_h, s_h = np.asarray(parent), np.asarray(seen)
                 # Re-emit every vertex whose label or membership changed — the
                 # observable effect of the reference's feedback re-emissions
-                # (IterativeConnectedComponents.java:116-167).
+                # (IterativeConnectedComponents.java:116-167) — as one
+                # vectorized block per micro-batch.
                 changed = (s_h & ~prev_seen) | (s_h & (p_h != prev))
-                for v in np.nonzero(changed)[0]:
-                    yield (int(v), int(p_h[v]))
+                idx = np.nonzero(changed)[0]
+                if len(idx):
+                    yield RecordBlock((idx.astype(np.int64), p_h[idx].astype(np.int64)))
                 prev, prev_seen = p_h, s_h
             self.final_labels = np.asarray(parent)
 
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)
