@@ -289,6 +289,37 @@ int64_t pack_edges_ef40(const int32_t* src, const int32_t* dst, int64_t n,
   return q - out;
 }
 
+// Host keyBy router: scatter edges into per-owner-shard buckets in ONE pass
+// (owner = key % num_shards; key is src or dst).  The numpy path selects each
+// shard's edges with a boolean mask — S full passes over the batch; this is
+// the native equivalent of the reference runtime's hash partitioner feeding
+// the network shuffle (SummaryBulkAggregation.java:78).  Buckets are
+// [num_shards, cap] row-major; arrival order is preserved within a shard
+// (stable, matching the numpy path).  Returns edges written, or -1 on a
+// bucket overflow (cap too small) so callers never drop silently.
+int64_t route_edges(const int32_t* src, const int32_t* dst, int64_t n,
+                    int32_t num_shards, int32_t key_is_src, int64_t cap,
+                    int32_t* out_src, int32_t* out_dst, int64_t* counts) {
+  if (num_shards <= 0 || cap <= 0) return -1;
+  for (int32_t s = 0; s < num_shards; ++s) counts[s] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t key = key_is_src ? src[i] : dst[i];
+    // floored modulo, matching Python/numpy '%' for negative keys (a vertex
+    // id that wrapped negative must land on the same owner everywhere)
+    int32_t owner = key % num_shards;
+    if (owner < 0) owner += num_shards;
+    int64_t k = counts[owner];
+    if (k >= cap) return -1;
+    int64_t slot = static_cast<int64_t>(owner) * cap + k;
+    out_src[slot] = src[i];
+    out_dst[slot] = dst[i];
+    counts[owner] = k + 1;
+  }
+  int64_t total = 0;
+  for (int32_t s = 0; s < num_shards; ++s) total += counts[s];
+  return total;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

@@ -44,8 +44,50 @@ def host_route(
     """Bucket edges by owner shard on the host, padding each bucket to a common
     capacity.  ``key`` picks the routing key ("src" or "dst"); an optional
     ``val`` pytree of per-edge payloads routes alongside the ids.  Relative
-    edge order is preserved within each shard (boolean-mask selection), so
-    per-key arrival-order semantics survive the shuffle."""
+    edge order is preserved within each shard, so per-key arrival-order
+    semantics survive the shuffle.
+
+    Value-less int32 batches scatter through the native single-pass router
+    (native/edge_parser.cpp route_edges — the hash-partitioner analog of the
+    reference runtime's shuffle feed); other inputs take the numpy path
+    (one boolean-mask selection per shard)."""
+    if (
+        val is None
+        and len(src)
+        and src.dtype == np.int32
+        and dst.dtype == np.int32
+    ):
+        from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+        lib = load_ingest_lib()
+        if lib is not None and hasattr(lib, "route_edges"):
+            cap = capacity or max(
+                1, int(np.bincount(
+                    (src if key == "src" else dst) % num_shards,
+                    minlength=num_shards,
+                ).max())
+            )
+            s = np.zeros((num_shards, cap), np.int32)
+            d = np.zeros((num_shards, cap), np.int32)
+            counts = np.zeros((num_shards,), np.int64)
+            src_c = np.ascontiguousarray(src)
+            dst_c = np.ascontiguousarray(dst)
+            import ctypes
+
+            wrote = lib.route_edges(
+                src_c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                dst_c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                len(src),
+                num_shards,
+                1 if key == "src" else 0,
+                cap,
+                s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                d.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+            if wrote == len(src):  # no overflow: buckets are complete
+                m = np.arange(cap)[None, :] < counts[:, None]
+                return RoutedEdges(s, d, m, None)
     owner = (src if key == "src" else dst) % num_shards
     counts = np.bincount(owner, minlength=num_shards)
     cap = capacity or (int(counts.max()) if len(src) else 1)

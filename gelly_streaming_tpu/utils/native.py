@@ -130,6 +130,19 @@ def load_ingest_lib():
                 ctypes.POINTER(ctypes.c_uint8),
             ]
             lib.pack_edges40.restype = ctypes.c_int64
+        if hasattr(lib, "route_edges"):
+            lib.route_edges.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.route_edges.restype = ctypes.c_int64
         if hasattr(lib, "pack_edges_ef40"):
             lib.pack_edges_ef40.argtypes = [
                 ctypes.POINTER(ctypes.c_int32),
