@@ -828,6 +828,7 @@ class MeshAggregationRunner:
         window_ms: Optional[int] = None,
         checkpoint_path: Optional[str] = None,
         restore: bool = True,
+        panes: Optional[Callable] = None,
     ) -> OutputStream:
         """(transform(running_summary),) per closed window, like run().
 
@@ -836,6 +837,12 @@ class MeshAggregationRunner:
         kill-and-resume work identically on the sharded data plane — the
         distributed analog of the reference's ListCheckpointed Merger
         (SummaryAggregation.java:127-135).
+
+        ``panes`` overrides the time plane: a zero-arg callable returning a
+        WindowPane iterator (zero-arg so the OutputStream stays re-runnable)
+        — e.g. multi-host gated windows merged across ingest hosts
+        (`parallel.multihost.merge_pane_shares`).  Without it, panes come
+        from the stream's own tumbling assignment.
         """
         cfg = stream.cfg
         window_ms = window_ms or self.agg.window_ms or cfg.window_ms
@@ -886,9 +893,13 @@ class MeshAggregationRunner:
             # so one row-sharded placement covers rows/counts/raw buckets —
             # each shard's bytes transfer straight to their owner device
             sharding = NamedSharding(self.mesh, P(self._axis))
-            panes = assign_tumbling_windows(stream.batches(), window_ms)
+            pane_iter = (
+                panes()
+                if panes is not None
+                else assign_tumbling_windows(stream.batches(), window_ms)
+            )
             with wire_mod.Prefetcher(
-                panes, prepare, device=sharding, depth=cfg.prefetch_depth
+                pane_iter, prepare, device=sharding, depth=cfg.prefetch_depth
             ) as pf:
                 yield from agg._merge_loop(
                     cfg,

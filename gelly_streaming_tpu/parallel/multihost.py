@@ -273,6 +273,58 @@ def multihost_tumbling_windows(
     yield from em.drain_through(board.global_max_pane())
 
 
+def merge_pane_shares(share_iters) -> Iterator[WindowPane]:
+    """Zip multiple ingest hosts' aligned pane-share sequences into whole
+    panes.
+
+    Both gated assemblers guarantee every host emits a (possibly empty)
+    share of exactly the same pane-id sequence in the same order, so shares
+    pair positionally; this merges each position's shares into one pane —
+    the glue between the multi-host time plane and a mesh data plane
+    (e.g. ``MeshAggregationRunner.run(stream, panes=...)``), standing in for
+    the reference's network shuffle out of parallel sources into the keyed
+    window (SummaryBulkAggregation.java:78-79).
+    """
+    import itertools
+
+    import jax
+
+    for shares in itertools.zip_longest(*share_iters):
+        if any(s is None for s in shares):
+            raise ValueError(
+                "pane share sequences diverged across hosts (unequal length)"
+            )
+        wid = shares[0].window_id
+        if any(s.window_id != wid for s in shares):
+            raise ValueError(
+                f"pane share ids diverged: {[s.window_id for s in shares]}"
+            )
+        # a host that saw no data (and declared no val_proto) contributes a
+        # None val on its empty shares — filter those out (they hold zero
+        # edges) instead of feeding a None/pytree mix to tree.map
+        vals = [s.val for s in shares if s.val is not None]
+        if not vals:
+            val = None
+        elif len(vals) == 1:
+            val = vals[0]
+        else:
+            val = jax.tree.map(lambda *parts: np.concatenate(parts), *vals)
+        times = [s.time for s in shares]
+        time = (
+            None
+            if all(t is None for t in times)
+            else np.concatenate([t for t in times if t is not None])
+        )
+        yield WindowPane(
+            wid,
+            shares[0].max_timestamp,
+            np.concatenate([s.src for s in shares]),
+            np.concatenate([s.dst for s in shares]),
+            val,
+            time,
+        )
+
+
 class _DeadlineRunner:
     """Run (potentially hanging) collectives with a wall-clock deadline.
 

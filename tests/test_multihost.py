@@ -387,3 +387,95 @@ def test_deadline_runner_timeout_and_fresh_worker():
     assert all(
         t.daemon for t in threading.enumerate() if "watermark" in t.name
     ), names
+
+
+def test_multihost_panes_feed_mesh_aggregation():
+    """The composed deployment shape: multi-host gated windows (DCN time
+    plane) merged across hosts and folded by the MeshAggregationRunner (ICI
+    data plane) — emissions must equal a single-host run over the union of
+    the hosts' edges."""
+    from gelly_streaming_tpu.core.aggregation import MeshAggregationRunner
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+
+    window_ms = 100
+    cfg = StreamConfig(vertex_capacity=64, batch_size=4, window_ms=window_ms)
+    host_edges = {
+        0: [(1, 2, 5), (2, 3, 15), (5, 6, 105)],
+        1: [(3, 4, 8), (7, 8, 110), (6, 7, 115)],
+    }
+
+    def gathered_panes():
+        board = mh.ProcessWatermarkBoard(2)
+        shares = {h: [] for h in host_edges}
+        errors = []
+
+        def work(h):
+            try:
+                shares[h] = list(
+                    mh.multihost_tumbling_windows(
+                        _batches([e for e in host_edges[h]]),
+                        window_ms,
+                        h,
+                        board,
+                        timeout=30.0,
+                    )
+                )
+            except BaseException as e:  # surfaced by the main thread
+                errors.append(e)
+
+        ts = [threading.Thread(target=work, args=(h,)) for h in host_edges]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60.0)
+        assert not errors, errors
+        return mh.merge_pane_shares([iter(shares[0]), iter(shares[1])])
+
+    runner = MeshAggregationRunner(ConnectedComponents())
+    stream = EdgeStream.from_collection([], cfg)  # pane source overridden
+    got = [
+        str(r[0])
+        for r in runner.run(stream, panes=gathered_panes)
+    ]
+
+    union = sorted(
+        host_edges[0] + host_edges[1], key=lambda e: e[2]
+    )
+    single = EdgeStream.from_collection(
+        [(s, d, 0.0, t) for (s, d, t) in union], cfg, batch_size=4, with_time=True
+    )
+    want = [str(r[0]) for r in ConnectedComponents().run(single)]
+    assert got == want
+
+
+def test_merge_pane_shares_mixed_empty_val_share():
+    """A host with no data closes empty shares with val=None (no val_proto
+    learned); merging with peers' val-carrying shares must not die on the
+    None/pytree mix."""
+    from gelly_streaming_tpu.core.windows import WindowPane
+
+    full = WindowPane(
+        window_id=0,
+        max_timestamp=99,
+        src=np.array([1, 2], np.int32),
+        dst=np.array([2, 3], np.int32),
+        val=np.array([0.5, 0.25]),
+        time=np.array([5, 6], np.int64),
+    )
+    empty = WindowPane(
+        window_id=0,
+        max_timestamp=99,
+        src=np.empty((0,), np.int32),
+        dst=np.empty((0,), np.int32),
+        val=None,
+        time=None,
+    )
+    merged = list(mh.merge_pane_shares([iter([full]), iter([empty])]))
+    assert len(merged) == 1
+    np.testing.assert_array_equal(merged[0].src, [1, 2])
+    np.testing.assert_array_equal(merged[0].val, [0.5, 0.25])
+    # diverged sequences fail loudly
+    with pytest.raises(ValueError):
+        list(mh.merge_pane_shares([iter([full]), iter([])]))
