@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gelly_streaming_tpu.core.config import StreamConfig
-from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 
 
 class DegreeDistState(NamedTuple):
@@ -88,18 +88,22 @@ class DegreeDistribution:
         self._kernel = jax.jit(degree_dist_update)
 
     def run(self, stream) -> OutputStream:
-        def records():
+        def blocks():
             state = init_state(stream.cfg)
             for batch in stream.batches():
                 state, recs, rmask = self._kernel(
                     state, batch.src, batch.dst, batch.sign, batch.mask
                 )
-                r_h = np.asarray(recs)
-                m_h = np.asarray(rmask)
-                for i in range(r_h.shape[0]):
-                    for slot in range(4):
-                        if m_h[i, slot]:
-                            yield (int(r_h[i, slot, 0]), int(r_h[i, slot, 1]))
+                # [B, 4, 2] per-edge record slots -> one compacted block per
+                # micro-batch, flattened in the reference's emission order
+                # (per edge: u-new, u-old, v-new, v-old)
+                r_h = np.asarray(recs).reshape(-1, 2)
+                m_h = np.asarray(rmask).reshape(-1)
+                idx = np.nonzero(m_h)[0]
+                if len(idx):
+                    yield RecordBlock(
+                        (r_h[idx, 0].astype(np.int64), r_h[idx, 1].astype(np.int64))
+                    )
             self.final_state = state
 
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)

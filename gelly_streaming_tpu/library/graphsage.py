@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.core.snapshot import SnapshotStream
 from gelly_streaming_tpu.core.types import EdgeDirection
 
@@ -172,11 +172,10 @@ class GraphSAGEWindows:
 
     def output(self, snapshot: SnapshotStream) -> OutputStream:
         """(vertex, embedding-norm) records — a compact observable stream."""
-
-        def records():
+        def blocks():
             for keys, emb in self.run(snapshot):
-                norms = np.linalg.norm(emb, axis=1)
-                for k, n in zip(keys, norms):
-                    yield (int(k), float(n))
+                yield RecordBlock(
+                    (keys.astype(np.int64), np.linalg.norm(emb, axis=1))
+                )
 
-        return OutputStream(records)
+        return OutputStream(blocks_fn=blocks)
