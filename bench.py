@@ -109,8 +109,12 @@ def _device_fold_eps(agg, stream, batch: int, trace_dir, reps: int = 48) -> floa
 def _triangle_latency(seed: int = 0, windows: int = 7, k: int = 4096):
     """p50/p95 per-pane triangle-count latency through the pipelined pane
     runner (Pallas MXU kernel; transfers overlap the previous pane's
-    compute)."""
-    from gelly_streaming_tpu.library.triangles import pipelined_pane_counts
+    compute).  A sequential pass over the same panes prints to stderr so the
+    pipelining win is visible next to the headline number."""
+    from gelly_streaming_tpu.library.triangles import (
+        _pane_triangle_count,
+        pipelined_pane_counts,
+    )
     from gelly_streaming_tpu.utils.metrics import WindowLatencyRecorder
 
     rng = np.random.default_rng(seed)
@@ -125,6 +129,16 @@ def _triangle_latency(seed: int = 0, windows: int = 7, k: int = 4096):
     rec = WindowLatencyRecorder()
     counts = pipelined_pane_counts(panes, recorder=rec, warmup=1)
     assert len(counts) == windows + 1
+    seq = WindowLatencyRecorder()
+    for src, dst in panes[1:]:  # pane 0 already compiled/warmed everything
+        seq.window_closed()
+        _pane_triangle_count(src, dst)
+        seq.result_emitted()
+    print(
+        f"triangle pane p50: pipelined {rec.percentile(50):.1f} ms vs "
+        f"sequential {seq.percentile(50):.1f} ms",
+        file=sys.stderr,
+    )
     return rec.percentile(50), rec.percentile(95)
 
 
