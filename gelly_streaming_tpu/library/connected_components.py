@@ -238,7 +238,15 @@ class BlockShardedCC:
         cap = max(1, 1 << (int(counts.max()) - 1).bit_length())
         return host_route(u, v, n, key="src", capacity=cap)
 
-    def run(self, stream) -> OutputStream:
+    def run(self, stream, panes=None) -> OutputStream:
+        """One [S, C/S] label-block record per closed pane.
+
+        ``panes``: optional zero-arg callable returning a WindowPane iterator
+        (e.g. multi-host gated windows via
+        ``parallel.multihost.merge_pane_shares``), overriding the stream's
+        own tumbling assignment — same contract as
+        ``MeshAggregationRunner.run``.
+        """
         from gelly_streaming_tpu.core.windows import assign_tumbling_windows
 
         cfg = stream.cfg
@@ -256,7 +264,12 @@ class BlockShardedCC:
                 init_label_blocks(cfg.vertex_capacity, n),
                 NamedSharding(self.mesh, P(SHARD_AXIS)),
             )
-            for pane in assign_tumbling_windows(stream.batches(), window_ms):
+            pane_iter = (
+                panes()
+                if panes is not None
+                else assign_tumbling_windows(stream.batches(), window_ms)
+            )
+            for pane in pane_iter:
                 if len(pane.src) == 0:
                     continue
                 routed = self._route_pane(pane.src, pane.dst)

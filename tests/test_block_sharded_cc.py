@@ -85,3 +85,26 @@ def test_path_graph_worst_diameter():
 def test_unshard_roundtrip():
     blocks = init_label_blocks(32, 8)
     np.testing.assert_array_equal(unshard_labels(blocks), np.arange(32))
+
+
+def test_block_sharded_cc_accepts_pane_override():
+    from gelly_streaming_tpu.core.windows import WindowPane
+
+    c = 64
+    cfg = StreamConfig(vertex_capacity=c, batch_size=4)
+
+    def panes():
+        yield WindowPane(
+            window_id=0,
+            max_timestamp=99,
+            src=np.array([1, 2], np.int32),
+            dst=np.array([2, 3], np.int32),
+            val=None,
+            time=None,
+        )
+
+    cc = BlockShardedCC()
+    stream = EdgeStream.from_collection([], cfg)
+    outs = list(cc.run(stream, panes=panes))
+    labels = unshard_labels(outs[-1][0])
+    assert labels[1] == labels[2] == labels[3] == 1

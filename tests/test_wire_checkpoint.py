@@ -251,3 +251,60 @@ def test_wire_resume_from_legacy_windowed_snapshot(tmp_path):
         .collect()
     )
     assert refolded[0][0].components() == clean[0][0].components()
+
+
+def test_wire_checkpoint_resumes_across_encodings(tmp_path):
+    """The snapshot stores the fold carry + batch position — both encoding
+    agnostic — so a checkpoint written under the plain wire may resume under
+    EF40 (and the exactly-once count still proves no batch is lost/refolded)."""
+    import gelly_streaming_tpu.utils.checkpoint as ckpt
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core.aggregation import SummaryBulkAggregation
+
+    class EdgeCount(SummaryBulkAggregation):
+        order_free = True  # counting is order-free; EF40-eligible
+
+        def initial_state(self, cfg):
+            return jnp.zeros((), jnp.int32)
+
+        def update(self, state, src, dst, val, mask):
+            return state + jnp.sum(mask.astype(jnp.int32))
+
+        def combine(self, a, b):
+            return a + b
+
+    src, dst = _edges(n=1024)
+    path = str(tmp_path / "xenc")
+    plain = StreamConfig(
+        vertex_capacity=128, batch_size=64, wire_checkpoint_batches=4,
+        wire_encoding="plain",
+    )
+    real_save = ckpt.save_state
+    saves = []
+
+    def crashing_save(p, state):
+        real_save(p, state)
+        saves.append(p)
+        if len(saves) == 2:
+            raise _Crash()
+
+    ckpt.save_state = crashing_save
+    try:
+        with pytest.raises(_Crash):
+            EdgeStream.from_arrays(src, dst, plain).aggregate(
+                EdgeCount(), checkpoint_path=path
+            ).collect()
+    finally:
+        ckpt.save_state = real_save
+
+    ef = StreamConfig(
+        vertex_capacity=128, batch_size=64, wire_checkpoint_batches=4,
+        wire_encoding="ef40",
+    )
+    out = (
+        EdgeStream.from_arrays(src, dst, ef)
+        .aggregate(EdgeCount(), checkpoint_path=path)
+        .collect()
+    )
+    assert int(out[0][0]) == 1024  # exactly-once across the encoding switch
