@@ -11,11 +11,16 @@ and prints ONE JSON line of metrics.
 
   python -m gelly_streaming_tpu.examples.measurements degrees       [options]
   python -m gelly_streaming_tpu.examples.measurements bipartiteness [options]
-  python -m gelly_streaming_tpu.examples.measurements triangles    [options]
+  python -m gelly_streaming_tpu.examples.measurements triangles     [options]
+  python -m gelly_streaming_tpu.examples.measurements spanner       [options]
+  python -m gelly_streaming_tpu.examples.measurements matching      [options]
 
 Options: --edges N --vertices C --batch B --seed S; triangles also takes
 --windows W --pane-vertices K (panes are K-vertex random graphs counted with
-the MXU kernel; reports p50/p95 per-window latency).
+the MXU kernel; reports p50/p95 per-window latency); spanner adds
+--max-degree D --k K (two-phase batch admission, reports edges/s and the
+admitted spanner size); matching reports the reference's net-runtime metric
+(CentralizedWeightedMatching.java:62-64) plus edges/s.
 """
 
 from __future__ import annotations
@@ -189,6 +194,49 @@ def measure_spanner(args) -> dict:
     }
 
 
+def measure_matching(args) -> dict:
+    """Centralized greedy weighted-matching net runtime — the single
+    measurement the reference itself ships (CentralizedWeightedMatching.java:
+    62-64 prints getNetRuntime over its input), generalized to a synthetic
+    weighted stream with a reported edges/s."""
+    import time
+
+    import jax
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
+
+    rng = np.random.default_rng(args.seed)
+    src = rng.integers(0, args.vertices, args.edges)
+    dst = rng.integers(0, args.vertices, args.edges)
+    w = rng.random(args.edges).astype(np.float32)
+    edges = list(zip(src.tolist(), dst.tolist(), w.tolist()))
+    cfg = StreamConfig(vertex_capacity=args.vertices, batch_size=args.batch)
+
+    def run():
+        algo = CentralizedWeightedMatching()
+        events = algo.run(
+            EdgeStream.from_collection(edges, cfg, batch_size=args.batch)
+        ).collect()
+        jax.block_until_ready(algo.final_state.partner)
+        return algo, events
+
+    run()  # compile warmup
+    t0 = time.perf_counter()
+    algo, events = run()
+    net_runtime_s = time.perf_counter() - t0
+    matched = int((np.asarray(algo.final_state.partner) >= 0).sum()) // 2
+    return {
+        "workload": "matching",
+        "net_runtime_s": round(net_runtime_s, 3),
+        "edges_per_sec": round(args.edges / net_runtime_s, 1),
+        "edges_streamed": args.edges,
+        "matched_edges": matched,
+        "events": len(events),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="measurements", description=__doc__)
     sub = p.add_subparsers(dest="workload", required=True)
@@ -213,12 +261,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--max-degree", type=int, default=64)
     sp.add_argument("--k", type=int, default=2)
     sp.add_argument("--seed", type=int, default=0)
+    sp = sub.add_parser("matching")
+    sp.add_argument("--edges", type=int, default=1 << 16)
+    sp.add_argument("--vertices", type=int, default=1 << 12)
+    sp.add_argument("--batch", type=int, default=1 << 13)
+    sp.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     fn = {
         "degrees": measure_degrees,
         "bipartiteness": measure_bipartiteness,
         "triangles": measure_triangles,
         "spanner": measure_spanner,
+        "matching": measure_matching,
     }[args.workload]
     print(json.dumps(fn(args)))
 

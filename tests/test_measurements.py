@@ -71,3 +71,24 @@ def test_triangles_reports_latency_percentiles(capsys):
 def test_requires_subcommand():
     with pytest.raises(SystemExit):
         measurements.main([])
+
+
+def test_matching_measurement(capsys):
+    from gelly_streaming_tpu.examples.measurements import main
+
+    main(["matching", "--edges", "512", "--vertices", "128", "--batch", "128"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["workload"] == "matching"
+    assert out["edges_streamed"] == 512
+    assert out["matched_edges"] > 0 and out["events"] >= out["matched_edges"]
+    assert out["net_runtime_s"] > 0
+
+
+def test_spanner_measurement(capsys):
+    from gelly_streaming_tpu.examples.measurements import main
+
+    main(["spanner", "--edges", "2048", "--vertices", "64", "--batch", "512"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["workload"] == "spanner"
+    assert 0 < out["spanner_edges"] <= 2048
+    assert out["edges_per_sec"] > 0
