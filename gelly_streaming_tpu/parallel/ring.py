@@ -117,6 +117,44 @@ def ring_lookup(
     return ans
 
 
+def ring_scatter_min(
+    block: jax.Array,
+    idx: jax.Array,
+    val: jax.Array,
+    num_shards: int,
+    axis_name: str = SHARD_AXIS,
+):
+    """Fold arbitrary (global id, value) scatter-min updates into a
+    modulo-sharded table — the WRITE counterpart of ``ring_lookup``.
+
+    The blocks make one full loop around the ring (S ``ppermute`` hops); at
+    each hop every shard scatter-mins the updates it holds for the currently
+    visiting block, so after the loop each block is back home having
+    absorbed every shard's updates.  Like the lookup, the cost is a flat C
+    values per pass regardless of how the update ids are distributed — no
+    per-(sender, receiver) capacities, no drops, no skew sensitivity.
+
+    Masked updates should carry the dtype's max as ``val`` (a no-op min).
+    """
+    rows = block.shape[0]
+    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    me = jax.lax.axis_index(axis_name)
+    blk = block
+    big = (
+        jnp.finfo(block.dtype).max
+        if jnp.issubdtype(block.dtype, jnp.inexact)
+        else jnp.iinfo(block.dtype).max
+    )
+    for t in range(num_shards):
+        owner = jnp.mod(me - t, num_shards)  # whose block is visiting now
+        sel = (idx % num_shards) == owner
+        r = jnp.clip(idx // num_shards, 0, rows - 1)
+        blk = blk.at[jnp.where(sel, r, 0)].min(jnp.where(sel, val, big))
+        # rotate even on the last step: S hops bring every block home
+        blk = jax.lax.ppermute(blk, axis_name, perm)
+    return blk
+
+
 def shard_features(features, num_shards: int):
     """[C, F] host features -> [S, C/S, F] modulo-ownership blocks."""
     import numpy as np

@@ -108,3 +108,21 @@ def test_block_sharded_cc_accepts_pane_override():
     outs = list(cc.run(stream, panes=panes))
     labels = unshard_labels(outs[-1][0])
     assert labels[1] == labels[2] == labels[3] == 1
+
+
+def test_skewed_hub_graph_no_capacity_blowup():
+    """A hub owning ~all edges: the unrouted design splits edges evenly over
+    shards regardless of key ownership (labels travel to the edges via ring
+    passes), so skew cannot blow up any shard's bucket."""
+    c = 256
+    hub = 7
+    edges = [(hub, i) for i in range(c) if i != hub]
+    labels, cc = _run(edges, c, batch_size=64)
+    expect = _host_min_labels(c, edges)
+    np.testing.assert_array_equal(labels, expect)
+    # per-shard bucket stays ~E/S even though one vertex owns every edge
+    s, d, m = cc._split_pane(
+        np.array([e[0] for e in edges], np.int32),
+        np.array([e[1] for e in edges], np.int32),
+    )
+    assert s.shape[1] <= 2 * (len(edges) // cc.num_shards + 1)

@@ -104,3 +104,43 @@ def test_sharded_sage_matches_replicated_kernel():
 def test_shard_features_requires_even_split():
     with pytest.raises(ValueError):
         shard_features(np.zeros((10, 4), np.float32), 8)
+
+
+def test_ring_scatter_min_folds_updates_from_all_shards():
+    """ring_scatter_min: every shard's (global id, value) updates land in the
+    owner block after one full loop, regardless of which shard held them."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
+    from gelly_streaming_tpu.parallel.ring import ring_scatter_min
+
+    s_n = 8
+    rows = 4  # table of 32 global slots, modulo-sharded
+    mesh = make_mesh(s_n)
+    big = np.iinfo(np.int32).max
+
+    def step(blocks, idx, val):
+        out = ring_scatter_min(blocks[0], idx[0], val[0], s_n)
+        return out[None]
+
+    fn = jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=P(SHARD_AXIS),
+        )
+    )
+    table = jnp.full((s_n, rows), 100, jnp.int32)
+    # every shard updates global slot 5 (owner 5 % 8) with a different value;
+    # shard k also updates slot k with value k
+    idx = jnp.stack([jnp.array([5, k], jnp.int32) for k in range(s_n)])
+    val = jnp.stack([jnp.array([50 + k, k], jnp.int32) for k in range(s_n)])
+    out = np.asarray(fn(table, idx, val))
+    flat = out.T.reshape(-1)  # global view: slot g at blocks[g % S, g // S]
+    assert flat[5] == 5  # slot 5: min(50..57, shard5's own "5") = 5
+    for k in range(s_n):
+        if k != 5:
+            assert flat[k] == min(k, 100)
