@@ -149,9 +149,36 @@ class OutputStream:
         return [",".join(_render(f) for f in rec) for rec in self]
 
     def write_csv(self, path: str) -> None:
+        """CSV sink in the reference's writeAsCsv rendering.
+
+        Flat integer/bool column blocks render vectorized (numpy string
+        ops — no per-record Python, matching the block emission design of
+        the heavy property traces); floats, objects, and constants fall back
+        to the per-record renderer, whose formatting is the golden contract.
+        """
         with open(path, "w") as f:
-            for line in self.lines():
-                f.write(line + "\n")
+            for blk in self.blocks():
+                cols = blk.columns
+                fast = blk.num_records > 0 and all(
+                    isinstance(c, np.ndarray)
+                    and c.ndim == 1
+                    and (c.dtype == bool or np.issubdtype(c.dtype, np.integer))
+                    for c in cols
+                )
+                if fast:
+                    parts = [
+                        np.where(c, "true", "false")
+                        if c.dtype == bool
+                        else c.astype(str)
+                        for c in cols
+                    ]
+                    lines = parts[0]
+                    for p in parts[1:]:
+                        lines = np.char.add(np.char.add(lines, ","), p)
+                    f.write("\n".join(lines.tolist()) + "\n")
+                else:
+                    for rec in blk.tuples():
+                        f.write(",".join(_render(fld) for fld in rec) + "\n")
 
     def print(self) -> None:
         for rec in self:

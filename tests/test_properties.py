@@ -120,3 +120,21 @@ def test_degree_stream_wide_vertex_space_uses_raw_columns():
         .collect()
     )
     assert recs == [(hub, 1), (hub, 2)]
+
+
+def test_write_csv_vectorized_matches_lines(tmp_path):
+    """The fast integer-block CSV path must render byte-identically to the
+    per-record golden renderer."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    cfg = StreamConfig(vertex_capacity=64, batch_size=4)
+    edges = [(1, 2), (1, 3), (2, 3), (3, 4), (3, 5)]
+
+    def stream():
+        return EdgeStream.from_collection(edges, cfg)
+
+    out = stream().get_degrees()
+    p = tmp_path / "deg.csv"
+    out.write_csv(str(p))
+    assert p.read_text().splitlines() == stream().get_degrees().lines()
