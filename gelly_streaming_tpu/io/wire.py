@@ -15,10 +15,11 @@ while the device computes.  This module supplies both:
   unpacking runs on device inside the consumer's jitted step, where the byte
   shuffles fuse into the surrounding kernel.
 
-* **WirePrefetcher** — a background thread that packs and ``device_put``s a
-  bounded number of batches ahead of the consumer, overlapping host packing
-  and link transfer with device compute (the Flink analog: source operators
-  run concurrently with downstream tasks, buffering on the network stack).
+* **WirePrefetcher** — a two-stage background pipeline (a pack thread and a
+  transfer thread) keeping a bounded number of batches ahead of the
+  consumer: packing item k+1 overlaps transferring item k, and both overlap
+  device compute (the Flink analog: source operators run concurrently with
+  downstream tasks, buffering on the network stack).
 """
 
 from __future__ import annotations
@@ -281,12 +282,15 @@ def unpack_records48(packed: np.ndarray, maskbits: np.ndarray, n: int):
 class Prefetcher:
     """Prepare + transfer items ahead of the device consumer.
 
-    Wraps an iterator; for each item a background thread runs
-    ``prepare(item) -> (meta, host_arrays)`` (host-side packing) and
-    ``device_put``s the arrays (a pytree, or None to skip the transfer),
-    yielding ``(meta, device_arrays)`` in order with up to ``depth`` results
-    in flight.  ``close()`` (or use as a context manager) releases the
-    producer thread and any in-flight buffers if the consumer stops early;
+    Wraps an iterator; ``prepare(item) -> (meta, host_arrays)`` (host-side
+    packing) runs on one background thread and the ``device_put`` of the
+    arrays (a pytree, or None to skip the transfer) on a SECOND, so packing
+    item k+1 overlaps transferring item k — on a multi-core host the
+    pipeline's rate is max(pack, transfer) instead of their sum (device_put
+    is synchronous: it occupies its thread for the whole transfer).  Yields
+    ``(meta, device_arrays)`` in order with up to ``depth`` results in
+    flight per stage.  ``close()`` (or use as a context manager) releases
+    the threads and any in-flight buffers if the consumer stops early;
     exhausting the iterator closes implicitly.
     """
 
@@ -297,51 +301,84 @@ class Prefetcher:
 
         self._prepare = prepare
         self._device = device if device is not None else jax.devices()[0]
+        self._midq: "queue.Queue" = queue.Queue(maxsize=depth)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, args=(iter(items),), daemon=True
-        )
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=self._run_pack, args=(iter(items),), daemon=True),
+            threading.Thread(target=self._run_put, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
 
-    def _put(self, item) -> bool:
+    def _put(self, q: "queue.Queue", item) -> bool:
         """Bounded put that gives up when the consumer has closed."""
         while not self._stop.is_set():
             try:
-                self._q.put(item, timeout=0.1)
+                q.put(item, timeout=0.1)
                 return True
             except queue.Full:
                 continue
         return False
 
-    def _run(self, it: Iterator):
-        import jax
+    def _get(self, q: "queue.Queue"):
+        while not self._stop.is_set():
+            try:
+                return q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+        return self._SENTINEL
 
+    def _run_pack(self, it: Iterator):
         try:
             for item in it:
                 if self._stop.is_set():
                     return
-                meta, host = self._prepare(item)
-                # device_put returns as soon as the transfer is enqueued, so
-                # the next item's packing overlaps the consumer's compute
-                dev = None if host is None else jax.device_put(host, self._device)
-                if not self._put((meta, dev)):
+                if not self._put(self._midq, self._prepare(item)):
                     return
         except BaseException as e:  # surfaced on the consumer thread
-            self._error = e
+            if self._error is None:  # keep the FIRST failure (root cause)
+                self._error = e
         finally:
-            self._put(self._SENTINEL)
+            self._put(self._midq, self._SENTINEL)
+
+    def _run_put(self):
+        import jax
+
+        try:
+            while True:
+                got = self._get(self._midq)
+                if got is self._SENTINEL:
+                    return
+                meta, host = got
+                # device_put blocks this thread for the transfer; the pack
+                # thread keeps preparing the next items meanwhile
+                dev = None if host is None else jax.device_put(host, self._device)
+                if not self._put(self._q, (meta, dev)):
+                    return
+        except BaseException as e:
+            if self._error is None:
+                self._error = e
+        finally:
+            self._put(self._q, self._SENTINEL)
 
     def close(self):
-        """Stop the producer and drop queued buffers (idempotent)."""
+        """Stop the producers and drop queued buffers (idempotent).
+
+        Joins BEFORE draining: with the stop flag set the bounded puts give
+        up within their timeout, and only once the threads have exited can
+        no in-flight put repopulate a queue after the drain (which would pin
+        a device buffer until GC)."""
         self._stop.set()
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
-        self._thread.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
+        for q in (self._midq, self._q):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
 
     def __enter__(self):
         return self

@@ -133,8 +133,9 @@ def test_prefetcher_early_close_releases_producer():
     it = iter(pf)
     next(it)
     pf.close()
-    pf._thread.join(timeout=5.0)
-    assert not pf._thread.is_alive()
+    for t in pf._threads:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in pf._threads)
 
 
 def test_prefetcher_propagates_errors():
