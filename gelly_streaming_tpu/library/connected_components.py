@@ -249,7 +249,20 @@ class BlockShardedCC:
         m = (np.arange(n * cap) < total).reshape(cap, n).T
         return split(src), split(dst), np.ascontiguousarray(m)
 
-    def run(self, stream, panes=None) -> OutputStream:
+    def _checkpoint_like(self, cfg):
+        return {
+            "labels": init_label_blocks(cfg.vertex_capacity, self.num_shards),
+            "last_window": np.full((), -1, np.int64),
+            "global_done": np.zeros((), bool),
+        }
+
+    def run(
+        self,
+        stream,
+        panes=None,
+        checkpoint_path: Optional[str] = None,
+        restore: bool = True,
+    ) -> OutputStream:
         """One [S, C/S] label-block record per closed pane.
 
         ``panes``: optional zero-arg callable returning a WindowPane iterator
@@ -257,6 +270,19 @@ class BlockShardedCC:
         ``parallel.multihost.merge_pane_shares``), overriding the stream's
         own tumbling assignment — same contract as
         ``MeshAggregationRunner.run``.
+
+        With ``checkpoint_path`` the label blocks + stream position snapshot
+        after every pane (the Merger's positional-checkpoint semantics —
+        the same skip-by-window-id / emit-before-snapshot protocol as
+        ``SummaryAggregation._merge_loop``, which remains the reference
+        implementation of these semantics): on restore the source replays
+        from the start, already-folded panes are skipped by window id, state
+        is exactly-once and emissions at-least-once — labels only ever
+        decrease, so a replayed fold is also idempotent by construction.
+        A snapshot downloads the full [C] label table to this process
+        (int32: 4 bytes/vertex per pane close); single-process meshes only —
+        a multi-process mesh has non-addressable shards and needs a
+        per-process (orbax-style) save, which this runner does not implement.
         """
         from gelly_streaming_tpu.core.windows import assign_tumbling_windows
 
@@ -268,12 +294,44 @@ class BlockShardedCC:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
+            sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+            if checkpoint_path:
+                import jax as _jax
+
+                if _jax.process_count() > 1:
+                    raise NotImplementedError(
+                        "BlockShardedCC checkpointing gathers the label "
+                        "table to one process; multi-process meshes need a "
+                        "per-process snapshot (not implemented)"
+                    )
+            start_after = -1
+            global_done = False
+            label_host = None
+            if checkpoint_path and restore:
+                from gelly_streaming_tpu.utils.checkpoint import (
+                    checkpoint_exists,
+                    load_state,
+                )
+
+                if checkpoint_exists(checkpoint_path):
+                    try:
+                        snap = load_state(
+                            checkpoint_path, self._checkpoint_like(cfg)
+                        )
+                    except ValueError:
+                        snap = None  # mismatched/legacy layout: start fresh
+                    if snap is not None:
+                        label_host = np.asarray(snap["labels"])
+                        start_after = int(snap["last_window"])
+                        global_done = bool(snap["global_done"])
             # block-distributed from the first byte: the [S, C/S] table goes
             # straight to its owners (committing it to one device first would
             # reintroduce the O(C)-per-chip footprint this class removes)
             label = jax.device_put(
-                init_label_blocks(cfg.vertex_capacity, n),
-                NamedSharding(self.mesh, P(SHARD_AXIS)),
+                label_host
+                if label_host is not None
+                else init_label_blocks(cfg.vertex_capacity, n),
+                sharding,
             )
             pane_iter = (
                 panes()
@@ -281,7 +339,10 @@ class BlockShardedCC:
                 else assign_tumbling_windows(stream.batches(), window_ms)
             )
             for pane in pane_iter:
-                if len(pane.src) == 0:
+                already = (0 <= pane.window_id <= start_after) or (
+                    pane.window_id == -1 and global_done
+                )
+                if already or len(pane.src) == 0:
                     continue
                 s, d, m = self._split_pane(
                     pane.src.astype(np.int32), pane.dst.astype(np.int32)
@@ -290,7 +351,22 @@ class BlockShardedCC:
                 label = step(
                     label, jnp.asarray(s), jnp.asarray(d), jnp.asarray(m)
                 )
+                # emit BEFORE snapshotting: a crash between the two re-emits
+                # this pane on recovery instead of dropping it
                 yield (label,)
+                start_after = max(pane.window_id, start_after)
+                global_done = global_done or pane.window_id == -1
+                if checkpoint_path:
+                    from gelly_streaming_tpu.utils.checkpoint import save_state
+
+                    save_state(
+                        checkpoint_path,
+                        {
+                            "labels": np.asarray(label),
+                            "last_window": np.full((), start_after, np.int64),
+                            "global_done": np.full((), global_done, bool),
+                        },
+                    )
 
         return OutputStream(records)
 
