@@ -95,6 +95,10 @@ class IncidenceRouter:
         self.capacity = capacity
         self.seed = seed
         self.broadcast = broadcast
+        # cap on m * num_samplers elements per vectorized routing pass; route
+        # splits bigger batches into sequential chunks (tunable, and tests
+        # shrink it to exercise the chunked path)
+        self.chunk_elems = 1 << 21
         self.edge_tab = np.full((num_samplers, 2), -1, np.int64)
         self.third = np.full((num_samplers,), -1, np.int64)
         self.edges_seen = 0
@@ -108,7 +112,26 @@ class IncidenceRouter:
         Columns: lane, idx (global 1-based edge index), resample, third (new
         watched vertex for resamples, -1 otherwise), hit_a, hit_b (whether
         the edge closes the lane's (edgeEndpoint, third) wedge sides).
+
+        Large batches process in bounded chunks: the vectorized pass builds
+        [m, num_samplers] intermediates, so m is capped (``chunk_elems``) to
+        bound the working set.  (The OUTPUT still scales with the number of
+        interested envelopes — in broadcast mode that is m * num_samplers
+        rows no matter how the routing is chunked.)
         """
+        chunk = max(1, self.chunk_elems // max(self.num_samplers, 1))
+        if len(src) > chunk:
+            outs = [
+                self.route(
+                    src[i : i + chunk],
+                    dst[i : i + chunk],
+                    None if mask is None else mask[i : i + chunk],
+                )
+                for i in range(0, len(src), chunk)
+            ]
+            return {
+                k: np.concatenate([o[k] for o in outs]) for k in outs[0]
+            }
         s = self.num_samplers
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
@@ -117,11 +140,16 @@ class IncidenceRouter:
             src, dst = src[sel], dst[sel]
         m = len(src)
         if m == 0:
-            out = {
-                k: np.zeros((0,), np.int64)
-                for k in ("idx", "resample", "third", "hit_a", "hit_b", "lane")
+            # dtypes must match the non-empty path's columns exactly, or a
+            # chunked concatenate would promote the bool columns to int64
+            return {
+                "lane": np.zeros((0,), np.int64),
+                "idx": np.zeros((0,), np.int64),
+                "resample": np.zeros((0,), bool),
+                "third": np.zeros((0,), np.int64),
+                "hit_a": np.zeros((0,), bool),
+                "hit_b": np.zeros((0,), bool),
             }
-            return out
         self.seen[src] = True
         self.seen[dst] = True
         idx = self.edges_seen + 1 + np.arange(m, dtype=np.int64)  # 1-based

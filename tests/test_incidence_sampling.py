@@ -79,3 +79,24 @@ def test_router_emits_sampled_edge_envelopes():
 def test_rejects_uneven_lane_split():
     with pytest.raises(ValueError):
         MeshSampledTriangleCount(10)  # 10 lanes over 8 shards
+
+
+def test_router_chunking_matches_single_pass():
+    """Chunked routing (bounded [m, s] intermediates) must equal one pass —
+    the carry state hand-off between chunks is the risky part."""
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 16, 400).astype(np.int64)
+    dst = rng.integers(0, 16, 400).astype(np.int64)
+    mask = rng.random(400) < 0.9
+    mask[64:128] = False  # a fully-masked chunk must not change dtypes
+    one = IncidenceRouter(num_samplers=8, capacity=16, seed=3)
+    env_one = one.route(src, dst, mask)
+
+    chunked = IncidenceRouter(num_samplers=8, capacity=16, seed=3)
+    chunked.chunk_elems = 64 * 8  # 64-edge chunks: the chunked branch runs
+    env_chunks = chunked.route(src, dst, mask)
+    for k in env_one:
+        assert env_one[k].dtype == env_chunks[k].dtype, k
+        np.testing.assert_array_equal(env_one[k], env_chunks[k])
+    np.testing.assert_array_equal(one.edge_tab, chunked.edge_tab)
+    np.testing.assert_array_equal(one.third, chunked.third)
