@@ -1,0 +1,94 @@
+"""Seeded configuration fuzz of the packed-wire fast path.
+
+One test sweeps (n_edges, capacity, batch_size, encoding, crash point)
+combinations, asserting CC labels against a host union-find every time —
+the cheap, wide regression net over the ingest plane's many code paths
+(pair40/width-2/EF40 encodings, tail batches, checkpoint resume)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import gelly_streaming_tpu.utils.checkpoint as ckpt
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+from gelly_streaming_tpu.ops import unionfind as uf
+
+
+def _host_min_labels(capacity, src, dst):
+    parent = np.arange(capacity)
+
+    def find(v):
+        while parent[v] != v:
+            parent[v] = parent[parent[v]]
+            v = parent[v]
+        return v
+
+    for a, b in zip(src, dst):
+        ra, rb = find(int(a)), find(int(b))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    return np.array([find(v) for v in range(capacity)])
+
+
+CASES = [
+    # (n_edges, capacity, batch, encoding)
+    (257, 1 << 6, 64, "plain"),     # tail batch, width-2 wire
+    (512, 1 << 6, 64, "ef40"),      # EF40, exact batches
+    (999, 1 << 10, 128, "ef40"),    # EF40 with tail
+    (300, (1 << 16) + 8, 64, "plain"),  # width-3 wire (no EF40 legal)
+    (64, 1 << 18, 64, "plain"),     # pair40 wire, single batch
+    (1, 1 << 6, 64, "plain"),       # single edge
+]
+
+
+@pytest.mark.parametrize("n,cap,batch,enc", CASES)
+def test_wire_cc_matches_host_union_find(n, cap, batch, enc):
+    rng = np.random.default_rng(n * 31 + cap)
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    cfg = StreamConfig(vertex_capacity=cap, batch_size=batch, wire_encoding=enc)
+    out = EdgeStream.from_arrays(src, dst, cfg).aggregate(ConnectedComponents())
+    labels = np.asarray(jax.jit(uf.compress)(out.collect()[-1][0].parent))
+    np.testing.assert_array_equal(labels, _host_min_labels(cap, src, dst))
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+@pytest.mark.parametrize("crash_after,enc", [(1, "plain"), (3, "ef40"), (2, "plain")])
+def test_wire_cc_crash_resume_fuzz(tmp_path, monkeypatch, crash_after, enc):
+    rng = np.random.default_rng(crash_after * 7)
+    n, cap = 800, 128
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    cfg = StreamConfig(
+        vertex_capacity=cap, batch_size=64, wire_checkpoint_batches=2,
+        wire_encoding=enc,
+    )
+    path = str(tmp_path / f"fz{crash_after}")
+    real = ckpt.save_state
+    count = {"n": 0}
+
+    def crashing(p, state):
+        real(p, state)
+        count["n"] += 1
+        if count["n"] == crash_after:
+            raise _Crash()
+
+    monkeypatch.setattr(ckpt, "save_state", crashing)
+    with pytest.raises(_Crash):
+        EdgeStream.from_arrays(src, dst, cfg).aggregate(
+            ConnectedComponents(), checkpoint_path=path
+        ).collect()
+    monkeypatch.setattr(ckpt, "save_state", real)
+    out = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents(), checkpoint_path=path)
+        .collect()
+    )
+    labels = np.asarray(jax.jit(uf.compress)(out[-1][0].parent))
+    np.testing.assert_array_equal(labels, _host_min_labels(cap, src, dst))
