@@ -197,3 +197,55 @@ def test_wire_ef40_bipartiteness_matches_plain():
             .collect()
         )
         assert str(plain[-1][0]) == str(ef[-1][0])
+
+
+def test_aggregate_strategy_selection_matrix(monkeypatch):
+    """run() picks wire / mesh / simulated correctly, including with
+    checkpointing (the wire path no longer opts out)."""
+    import gelly_streaming_tpu.core.aggregation as agg_mod
+
+    src, dst = _random_edges(n=128, c=32)
+    calls = []
+
+    orig_wire = agg_mod.SummaryAggregation._wire_records
+    orig_mesh = agg_mod.MeshAggregationRunner.run
+
+    def spy_wire(self, *a, **k):
+        calls.append("wire")
+        return orig_wire(self, *a, **k)
+
+    def spy_mesh(self, *a, **k):
+        calls.append("mesh")
+        return orig_mesh(self, *a, **k)
+
+    monkeypatch.setattr(agg_mod.SummaryAggregation, "_wire_records", spy_wire)
+    monkeypatch.setattr(agg_mod.MeshAggregationRunner, "run", spy_mesh)
+
+    single = StreamConfig(vertex_capacity=32, batch_size=64)
+    sharded = StreamConfig(vertex_capacity=32, batch_size=64, num_shards=8)
+
+    EdgeStream.from_arrays(src, dst, single).aggregate(
+        ConnectedComponents()
+    ).collect()
+    assert calls == ["wire"]
+
+    calls.clear()
+    EdgeStream.from_arrays(src, dst, sharded).aggregate(
+        ConnectedComponents()
+    ).collect()
+    assert calls == ["mesh"]
+
+    calls.clear()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        EdgeStream.from_arrays(src, dst, single).aggregate(
+            ConnectedComponents(), checkpoint_path=f"{d}/ck"
+        ).collect()
+    assert calls == ["wire"]  # checkpointing stays on the fast path
+
+    calls.clear()
+    EdgeStream.from_collection(
+        list(zip(src.tolist(), dst.tolist())), single, 64
+    ).aggregate(ConnectedComponents()).collect()
+    assert calls == []  # simulated path: neither wire nor mesh
