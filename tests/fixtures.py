@@ -32,3 +32,22 @@ def assert_lines(output_lines, expected: str):
     got = sorted(output_lines)
     want = sorted(l for l in expected.strip().split("\n") if l)
     assert got == want, f"\n got: {got}\nwant: {want}"
+
+
+def host_min_labels(capacity, src, dst):
+    """Reference union-find (min-root labels) for cross-checking CC kernels."""
+    import numpy as np
+
+    parent = np.arange(capacity)
+
+    def find(v):
+        while parent[v] != v:
+            parent[v] = parent[parent[v]]
+            v = parent[v]
+        return v
+
+    for a, b in zip(src, dst):
+        ra, rb = find(int(a)), find(int(b))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    return np.array([find(v) for v in range(capacity)])

@@ -19,19 +19,11 @@ from gelly_streaming_tpu.library.connected_components import (
 
 
 def _host_min_labels(capacity, edges):
-    parent = np.arange(capacity)
+    from fixtures import host_min_labels
 
-    def find(v):
-        while parent[v] != v:
-            parent[v] = parent[parent[v]]
-            v = parent[v]
-        return v
-
-    for a, b in edges:
-        ra, rb = find(a), find(b)
-        if ra != rb:
-            parent[max(ra, rb)] = min(ra, rb)
-    return np.array([find(v) for v in range(capacity)])
+    return host_min_labels(
+        capacity, [e[0] for e in edges], [e[1] for e in edges]
+    )
 
 
 def _run(edges, capacity, batch_size=64):

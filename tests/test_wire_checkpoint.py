@@ -205,9 +205,6 @@ def test_wire_resume_from_legacy_windowed_snapshot(tmp_path):
     )
 
     # done legacy snapshot: the global pane finished under the old layout
-    final_state = clean[0][0]  # DisjointSet transform view
-    from gelly_streaming_tpu.core.aggregation import SummaryAggregation
-
     folded = agg.initial_state(cfg)
     # fold the whole stream once to get a real summary pytree
     import jax.numpy as jnp

@@ -17,20 +17,7 @@ from gelly_streaming_tpu.library.connected_components import ConnectedComponents
 from gelly_streaming_tpu.ops import unionfind as uf
 
 
-def _host_min_labels(capacity, src, dst):
-    parent = np.arange(capacity)
-
-    def find(v):
-        while parent[v] != v:
-            parent[v] = parent[parent[v]]
-            v = parent[v]
-        return v
-
-    for a, b in zip(src, dst):
-        ra, rb = find(int(a)), find(int(b))
-        if ra != rb:
-            parent[max(ra, rb)] = min(ra, rb)
-    return np.array([find(v) for v in range(capacity)])
+from fixtures import host_min_labels as _host_min_labels
 
 
 CASES = [
