@@ -118,8 +118,8 @@ class SummaryAggregation:
     def _wire_eligible(self, stream) -> bool:
         return (
             getattr(stream, "_wire_arrays", None) is not None
-            and self._num_partitions(stream.cfg) == 1
-        )
+            or getattr(stream, "_wire_packed", None) is not None
+        ) and self._num_partitions(stream.cfg) == 1
 
     def _wire_fused_step(self, stream, batch: int, width):
         """Jitted (stage-states, summary), wire-buffer -> carry step, cached so
@@ -283,11 +283,27 @@ class SummaryAggregation:
         from gelly_streaming_tpu.io import wire
 
         cfg = stream.cfg
-        src, dst, batch = stream._wire_arrays
-        batch = min(batch, max(len(src), 1))
-        width = self._wire_width(cfg)
+        packed = getattr(stream, "_wire_packed", None)
+        if packed is not None:
+            # replay source: buffers are already wire-format; the loop's only
+            # host cost is the transfer itself
+            bufs, batch, width, tail_pair = packed
+            # (EF40 x order-sensitive refusal happens in run(), which guards
+            # every consumption path, not just this one)
+            src = dst = None
+            n_full = len(bufs)
+            total_edges = n_full * batch + (len(tail_pair[0]) if tail_pair else 0)
+        else:
+            src, dst, batch = stream._wire_arrays
+            batch = min(batch, max(len(src), 1))
+            width = self._wire_width(cfg)
+            n_full = len(src) // batch
+            rem = len(src) - n_full * batch
+            tail_pair = (
+                (src[n_full * batch :], dst[n_full * batch :]) if rem else None
+            )
+            total_edges = len(src)
         fused, tail = self._wire_fused_step(stream, batch, width)
-        n_full = len(src) // batch
         start_batch, carry_host, done_summary = self._wire_restore(
             stream, checkpoint_path if restore else None, batch
         )
@@ -326,36 +342,53 @@ class SummaryAggregation:
         every = cfg.wire_checkpoint_batches
         since_snap = 0
 
-        def full_batches():
-            for i in range(start_batch, n_full):
-                yield src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch]
+        def device_buffers():
+            if packed is not None:
+                with wire.Prefetcher(
+                    bufs[start_batch:],
+                    lambda b: (None, b),
+                    depth=cfg.prefetch_depth,
+                ) as pf:
+                    for _, b in pf:
+                        yield b
+                return
 
-        with wire.WirePrefetcher(
-            full_batches(), width, depth=cfg.prefetch_depth
-        ) as pf:
-            for i, (buf, _) in enumerate(pf):
-                carry = fused(carry, buf)
-                since_snap += 1
-                if checkpoint_path and every and since_snap >= every:
-                    # the snapshot must read the carry BEFORE the next fused
-                    # call donates it away
-                    snapshot(start_batch + i + 1, False, carry)
-                    since_snap = 0
-        rem = len(src) - n_full * batch
-        if rem:
+            def full_batches():
+                for i in range(start_batch, n_full):
+                    yield (
+                        src[i * batch : (i + 1) * batch],
+                        dst[i * batch : (i + 1) * batch],
+                    )
+
+            with wire.WirePrefetcher(
+                full_batches(), width, depth=cfg.prefetch_depth
+            ) as pf:
+                for b, _ in pf:
+                    yield b
+
+        for i, buf in enumerate(device_buffers()):
+            carry = fused(carry, buf)
+            since_snap += 1
+            if checkpoint_path and every and since_snap >= every:
+                # the snapshot must read the carry BEFORE the next fused
+                # call donates it away
+                snapshot(start_batch + i + 1, False, carry)
+                since_snap = 0
+        if tail_pair is not None:
+            rem = len(tail_pair[0])
             mask = np.zeros((batch,), bool)
             mask[:rem] = True
             pad_s = np.zeros((batch,), np.int32)
             pad_d = np.zeros((batch,), np.int32)
-            pad_s[:rem] = src[n_full * batch :]
-            pad_d[:rem] = dst[n_full * batch :]
+            pad_s[:rem] = tail_pair[0]
+            pad_d[:rem] = tail_pair[1]
             carry = tail(
                 carry,
                 jnp.asarray(pad_s),
                 jnp.asarray(pad_d),
                 jnp.asarray(mask),
             )
-        if len(src) == 0:
+        if total_edges == 0:
             return
         out = self.transform(carry[1])
         # emit BEFORE the final snapshot: a crash between the two re-emits on
@@ -405,6 +438,15 @@ class SummaryAggregation:
         runs the real sharded data plane (MeshAggregationRunner); otherwise
         partitions are simulated sequentially (the MiniCluster shape).  All
         paths share the Merger/checkpoint loop (`_merge_loop`)."""
+        packed = getattr(stream, "_wire_packed", None)
+        if packed is not None and isinstance(packed[2], tuple) and not self.order_free:
+            # EF40 replay buffers carry per-batch sorted multisets; EVERY
+            # consumption path (fast, mesh, simulated) would see reordered
+            # edges, so refuse up front rather than only on the fast path
+            raise ValueError(
+                "EF40 replay buffers carry a sorted multiset; this "
+                "aggregation is not order-free"
+            )
         if self._wire_eligible(stream):
             return OutputStream(
                 lambda: self._wire_records(stream, checkpoint_path, restore)

@@ -75,14 +75,18 @@ def _pack_edges40(src: np.ndarray, dst: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(b).reshape(-1)
 
 
-def _unpack_edges40(wire, n: int):
-    import jax.numpy as jnp
+def _unpack_edges40(wire, n: int, xp=None):
+    """40-bit pair decode; ``xp`` is the array namespace (jnp on device —
+    the default — or np for the host-side replay slow path: ONE
+    implementation serves both so the formats cannot drift)."""
+    if xp is None:
+        import jax.numpy as xp
 
-    b = wire.reshape(n, 5).astype(jnp.uint32)
+    b = wire.reshape(n, 5).astype(xp.uint32)
     lo = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)  # bits 0..23
-    src = (lo & 0xFFFFF).astype(jnp.int32)
+    src = (lo & 0xFFFFF).astype(xp.int32)
     hi = (b[:, 2] >> 4) | (b[:, 3] << 4) | (b[:, 4] << 12)  # bits 20..39
-    dst = hi.astype(jnp.int32)
+    dst = hi.astype(xp.int32)
     return src, dst
 
 
@@ -209,24 +213,78 @@ def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
     return np.concatenate([low_bytes(src), low_bytes(dst)])
 
 
-def unpack_edges(wire, n: int, width):
-    """Device-side unpack: wire uint8 buffer -> (src, dst) int32[n].
+def unpack_edges(wire, n: int, width, xp=None):
+    """Wire uint8 buffer -> (src, dst) int32[n].
 
-    Jit-friendly (static n/width); the byte combines fuse into the caller's
-    surrounding kernel so the unpack adds no extra HBM round trip.
+    Device-side by default (jit-friendly, static n/width; the byte combines
+    fuse into the caller's surrounding kernel so the unpack adds no extra
+    HBM round trip).  Pass ``xp=np`` for a host-side decode of the
+    fixed-width encodings — the same code path, so host and device cannot
+    disagree.  EF40 needs the device scatter (or ``unpack_edges_host``).
     """
-    import jax.numpy as jnp
-
     if isinstance(width, tuple):  # (EF40, capacity)
         return unpack_edges_ef40(wire, n, width[1])
+    if xp is None:
+        import jax.numpy as xp
+
     if width == PAIR40:
-        return _unpack_edges40(wire, n)
-    b = wire.reshape(2, n, width).astype(jnp.uint32)
+        return _unpack_edges40(wire, n, xp)
+    b = wire.reshape(2, n, width).astype(xp.uint32)
     v = b[..., 0]
     for k in range(1, width):
         v = v | (b[..., k] << (8 * k))
-    v = v.astype(jnp.int32)
+    v = v.astype(xp.int32)
     return v[0], v[1]
+
+
+def pack_stream(
+    src: np.ndarray, dst: np.ndarray, batch: int, width
+) -> Tuple[list, Optional[Tuple[np.ndarray, np.ndarray]]]:
+    """Pre-pack a finite edge stream into per-batch wire buffers.
+
+    Returns ``(bufs, tail)``: full-batch uint8 buffers plus the raw
+    ``(src, dst)`` remainder (or None).  This is the producer side of the
+    replay contract (``EdgeStream.from_wire``): in the reference, records
+    reach the hot operator already serialized by the upstream network stack
+    (SummaryBulkAggregation.java:76-83 consumes Flink's wire tuples); the
+    TPU analog is a stream recorded in — or delivered already in — the
+    framework's own wire format.
+    """
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    n_full = len(src) // batch
+    bufs = [
+        pack_edges(src[i * batch : (i + 1) * batch], dst[i * batch : (i + 1) * batch], width)
+        for i in range(n_full)
+    ]
+    rem = len(src) - n_full * batch
+    tail = (src[n_full * batch :], dst[n_full * batch :]) if rem else None
+    return bufs, tail
+
+
+def unpack_edges_host(buf: np.ndarray, n: int, width):
+    """Host-side (numpy) decode of one wire buffer -> (src, dst) int32[n].
+
+    The replay source's slow-path materializer: consumers outside the fused
+    wire path (windowed ops, snapshots) get ordinary EdgeBatches.  The
+    fixed-width encodings reuse the device decode with ``xp=np``; EF40 —
+    whose device form needs a jax scatter — decodes the unary bitvector via
+    flatnonzero, with host==device equality pinned by tests/test_wire.py.
+    EF40 buffers decode to src-grouped order (the multiset, not the arrival
+    sequence — same contract as the device unpack).
+    """
+    buf = np.asarray(buf, np.uint8)
+    if isinstance(width, tuple):  # (EF40, capacity)
+        capacity = width[1]
+        bvbytes = (n + capacity + 7) // 8
+        bits = np.unpackbits(buf[:bvbytes], bitorder="little")[: n + capacity]
+        src = (np.flatnonzero(bits) - np.arange(n, dtype=np.int64)).astype(np.int32)
+        dst_lo, dst_hi = _unpack_edges40(
+            buf[bvbytes : bvbytes + 5 * ((n + 1) // 2)], (n + 1) // 2, np
+        )
+        dst = np.stack([dst_lo & 0xFFFFF, dst_hi], axis=1).reshape(-1)[:n]
+        return src, dst.astype(np.int32)
+    return unpack_edges(buf, n, width, xp=np)
 
 
 # ---------------------------------------------------------------------------

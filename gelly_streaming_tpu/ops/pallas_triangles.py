@@ -116,23 +116,62 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.jit, static_argnames=("k", "interpret"))
-def _count_from_edges(u, v, mask, k: int, interpret: bool):
-    """Device-side pane count: scatter the (possibly duplicated, uncanonical)
-    edge list into a dense [k, k] adjacency and run the MXU kernel.
-
-    Building the adjacency on device keeps the host->device transfer at the
-    edge list's size (8 B/edge) instead of the k*k matrix (the dense pane
-    previously shipped 16 MB/pane through the tunnel — ~200 ms — vs ~1 ms for
-    the edges), and the scatter dedups duplicate edges for free.
-    """
-    ok = mask & (u != v)
+def _adjacency_count(u, v, ok, k: int, interpret: bool):
+    """Scatter a (possibly duplicated, uncanonical) edge list into a dense
+    [k, k] adjacency and run the MXU kernel; the scatter dedups for free."""
     uu = jnp.where(ok, u, 0)
     vv = jnp.where(ok, v, 0)
     adj = jnp.zeros((k, k), jnp.bool_)
     adj = adj.at[uu, vv].max(ok)
     adj = adj.at[vv, uu].max(ok)
     return _count_halves(adj, interpret=interpret)
+
+
+_ID_BITS = 14  # MAX_K = 2^14, so a (u, v) pair packs into 28 bits of a uint32
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _count_from_packed(w, n, k: int, interpret: bool):
+    """Device-side pane count from the 4 B/edge packed pane wire format.
+
+    ``w``: uint32[cap] edge words (u | v << _ID_BITS), ``n``: traced edge
+    count (entries past n are padding — masked on device, so varying pane
+    sizes share one compiled kernel per pow2 capacity).  Halving the pane's
+    wire bytes matters because the transfer rides the same tunnel budget as
+    the ingest plane (BASELINE.md round-3 environment model).
+    """
+    u = (w & ((1 << _ID_BITS) - 1)).astype(jnp.int32)
+    v = (w >> _ID_BITS).astype(jnp.int32)
+    ok = (jnp.arange(w.shape[0], dtype=jnp.int32) < n) & (u != v)
+    return _adjacency_count(u, v, ok, k, interpret)
+
+
+def pack_pane(u: np.ndarray, v: np.ndarray, mask=None):
+    """Host-side pane pack: (u, v) -> (uint32[cap] edge words, n) at
+    4 B/edge, capacity padded to the next power of two so varying pane sizes
+    reuse a bounded set of compiled kernels.  Masked-out edges are dropped
+    here (the wire ships only live edges)."""
+    if mask is not None:
+        u, v = np.asarray(u)[mask], np.asarray(v)[mask]
+    n = len(u)
+    cap = max(1, 1 << (n - 1).bit_length()) if n else 1
+    w = np.zeros((cap,), np.uint32)
+    w[:n] = u.astype(np.uint32) | (v.astype(np.uint32) << _ID_BITS)
+    return w, np.int32(n)
+
+
+def pane_triangles_submit_packed(w, n, num_vertices: int):
+    """Dispatch a packed pane (from ``pack_pane``; host OR device-resident
+    arrays) without waiting.  Device-resident inputs let a prefetching
+    caller overlap the pane upload with the previous pane's compute."""
+    k = max(TILE, ((num_vertices + TILE - 1) // TILE) * TILE)
+    _check_k(k)
+    halves = _count_from_packed(w, n, k, _use_interpret())
+    try:
+        halves.copy_to_host_async()  # start the readback behind the compute
+    except AttributeError:
+        pass
+    return halves
 
 
 def pane_triangles_submit(u: np.ndarray, v: np.ndarray, num_vertices: int, mask=None):
@@ -146,27 +185,12 @@ def pane_triangles_submit(u: np.ndarray, v: np.ndarray, num_vertices: int, mask=
 
     ``u``/``v`` may contain duplicates and both orientations (the device
     scatter canonicalizes); self-loops are dropped.  ``num_vertices`` bounds
-    the ids.  The edge list is padded to the next power of two so varying pane
-    sizes reuse a bounded set of compiled kernels.
+    the ids.  The pane ships in the packed 4 B/edge wire form (pack_pane).
     """
-    k = max(TILE, ((num_vertices + TILE - 1) // TILE) * TILE)
-    _check_k(k)
-    n = len(u)
-    if n == 0:
+    if len(u) == 0:
         return None
-    cap = max(1, 1 << (n - 1).bit_length())
-    uu = np.zeros((cap,), np.int32)
-    vv = np.zeros((cap,), np.int32)
-    mm = np.zeros((cap,), bool)
-    uu[:n] = u
-    vv[:n] = v
-    mm[:n] = True if mask is None else mask
-    halves = _count_from_edges(uu, vv, mm, k, _use_interpret())
-    try:
-        halves.copy_to_host_async()  # start the readback behind the compute
-    except AttributeError:
-        pass
-    return halves
+    w, n = pack_pane(u, v, mask)
+    return pane_triangles_submit_packed(w, n, num_vertices)
 
 
 def triangles_from_halves(halves) -> int:
