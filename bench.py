@@ -36,7 +36,9 @@ Prints ONE JSON line:
   {"metric": "streaming_cc_edges_per_sec", "value": ..., "unit": "edges/s",
    "vs_baseline": ..., "trials": [...], "wire_gbps": [...],
    "pack_eps": ..., "e2e_eps": ..., "cpu_baseline_eps": ..., "device_eps": ...,
-   "triangle_p50_ms": ..., "triangle_p95_ms": ...}
+   "triangle_p50_ms": ..., "triangle_p95_ms": ...,
+   "triangle_device_p50_ms": ..., "triangle_panes_per_sec": ...}
+(triangle keys are null when that stage is skipped or fails)
 device_eps is the device-only fold rate (unpack + union-find on a resident
 buffer; a short separate profiler-traced run exercises the tracing subsystem
 without distorting the timing — the trace RPCs cost ~40 ms/step through the
@@ -73,11 +75,14 @@ def _settle_link(target_gbps: float, max_wait_s: float, probe_mb: int = 2) -> fl
     """
     import jax
 
-    buf = np.random.default_rng(7).integers(0, 256, probe_mb << 20).astype(np.uint8)
+    rng = np.random.default_rng(7)
     dev = jax.devices()[0]
-    jax.device_put(buf, dev).block_until_ready()  # first-touch, untimed
+    jax.device_put(np.zeros(probe_mb << 20, np.uint8), dev).block_until_ready()
     deadline = time.monotonic() + max_wait_s
     while True:
+        # fresh random content each probe: a repeated identical buffer could
+        # hit any transport-level caching and overstate the link
+        buf = rng.integers(0, 256, probe_mb << 20).astype(np.uint8)
         t0 = time.perf_counter()
         jax.device_put(buf, dev).block_until_ready()
         rate = buf.nbytes / (time.perf_counter() - t0) / 1e9
@@ -127,11 +132,20 @@ def _device_fold_eps(agg, stream, trace_dir, reps: int = 48) -> float:
     return eps
 
 
-def _triangle_latency(seed: int = 0, windows: int = 7, k: int = 4096):
-    """p50/p95 per-pane triangle-count latency through the pipelined pane
-    runner (Pallas MXU kernel; transfers overlap the previous pane's
-    compute).  A sequential pass over the same panes prints to stderr so the
-    pipelining win is visible next to the headline number."""
+def _triangle_latency(seed: int = 0, windows: int = 15, k: int = 4096):
+    """Per-pane triangle-count latency through the pipelined pane runner
+    (Pallas MXU kernel; 4 B/edge packed uploads ride the prefetcher under
+    the previous pane's compute).
+
+    Reports THREE views (see pipelined_pane_counts): close -> device
+    completion p50 (the data plane: scatter + MXU kernel, ~1-3 ms), close ->
+    host-visible result p50/p95 (adds the device->host result delivery —
+    ~40-65 ms through the session tunnel, an environmental floor; tens of
+    microseconds on a PCIe host), and the pipelined pane THROUGHPUT (panes/s
+    — readbacks of pane k overlap panes k+1.., so sustained rate is not
+    latency-bound).  A sequential pass prints alongside for contrast."""
+    import time as _time
+
     from gelly_streaming_tpu.library.triangles import (
         _pane_triangle_count,
         pipelined_pane_counts,
@@ -147,49 +161,70 @@ def _triangle_latency(seed: int = 0, windows: int = 7, k: int = 4096):
         )
         for _ in range(windows + 1)
     ]
+    _pane_triangle_count(*panes[0])  # compile/warm OUTSIDE the timed window
     rec = WindowLatencyRecorder()
-    counts = pipelined_pane_counts(panes, recorder=rec, warmup=1)
+    dev_rec = WindowLatencyRecorder()
+    t0 = _time.perf_counter()
+    counts = pipelined_pane_counts(
+        panes, recorder=rec, warmup=1, depth=4, device_recorder=dev_rec
+    )
+    pane_rate = (windows + 1) / (_time.perf_counter() - t0)
     assert len(counts) == windows + 1
     seq = WindowLatencyRecorder()
-    for src, dst in panes[1:]:  # pane 0 already compiled/warmed everything
+    for src, dst in panes[1:5]:  # pane 0 already compiled/warmed everything
         seq.window_closed()
         _pane_triangle_count(src, dst)
         seq.result_emitted()
     print(
-        f"triangle pane p50: pipelined {rec.percentile(50):.1f} ms vs "
-        f"sequential {seq.percentile(50):.1f} ms",
+        f"triangle pane p50: device {dev_rec.percentile(50):.1f} ms, "
+        f"host-visible {rec.percentile(50):.1f} ms, "
+        f"{pane_rate:.1f} panes/s pipelined vs sequential "
+        f"{seq.percentile(50):.1f} ms/pane",
         file=sys.stderr,
     )
-    return rec.percentile(50), rec.percentile(95)
+    return {
+        "triangle_p50_ms": rec.percentile(50),
+        "triangle_p95_ms": rec.percentile(95),
+        "triangle_device_p50_ms": dev_rec.percentile(50),
+        "triangle_panes_per_sec": pane_rate,
+    }
 
 
-def _init_watchdog(seconds: float):
-    """Fail fast with an explainable JSON line if device-backend init wedges.
+_PARTIAL = {}  # best results so far, emitted by the deadline watchdog
 
-    The session tunnel's client creation can hang indefinitely when the
-    tunnel service is down (observed round 3); without this the driver's
-    bench run would block forever with no artifact.  Returns a cancel()."""
+
+def _watchdog(seconds: float, what: str, exit_code: int):
+    """Emit an explainable JSON line and exit if ``what`` wedges.
+
+    The session tunnel's client creation — and, observed later in round 3,
+    mid-run RPCs — can hang indefinitely when the tunnel service goes down;
+    without this the driver's bench run would block forever with no
+    artifact.  The emitted line carries whatever metrics were already
+    measured (``_PARTIAL``).  Returns a cancel()."""
     import threading
 
     done = threading.Event()
 
     def watch():
         if not done.wait(seconds):
+            partial = dict(_PARTIAL)
+            # a fully-measured headline survives a later-phase wedge
+            value = partial.pop("value_so_far", None)
             print(
                 json.dumps(
                     {
-                        "error": "device backend init exceeded "
-                        f"{seconds:.0f}s — tunnel down or wedged; no "
-                        "throughput measured",
+                        "error": f"{what} exceeded {seconds:.0f}s — tunnel "
+                        "down or wedged; partial results only",
                         "metric": "streaming_cc_edges_per_sec",
-                        "value": None,
+                        "value": value,
                         "unit": "edges/s",
                         "vs_baseline": None,
+                        **partial,
                     }
                 ),
                 flush=True,
             )
-            os._exit(3)
+            os._exit(exit_code)
 
     threading.Thread(target=watch, daemon=True).start()
     return done.set
@@ -207,8 +242,10 @@ def main():
     # padded tail would ship 9 B/edge for its remainder)
     num_edges -= num_edges % batch
 
-    cancel_watchdog = _init_watchdog(
-        float(os.environ.get("GELLY_BENCH_INIT_TIMEOUT", 600))
+    cancel_init_watchdog = _watchdog(
+        float(os.environ.get("GELLY_BENCH_INIT_TIMEOUT", 600)),
+        "device backend init",
+        3,
     )
     import jax
 
@@ -220,7 +257,12 @@ def main():
     from gelly_streaming_tpu.utils.native import load_ingest_lib
 
     jax.devices()  # force backend init under the watchdog
-    cancel_watchdog()
+    cancel_init_watchdog()
+    # a second watchdog bounds the WHOLE bench: a tunnel wedge mid-run would
+    # otherwise hang a collect() forever and leave the driver artifact-less
+    _watchdog(
+        float(os.environ.get("GELLY_BENCH_DEADLINE", 1800)), "bench run", 4
+    )
 
     rng = np.random.default_rng(0)
     src = rng.integers(0, capacity, num_edges).astype(np.int32)
@@ -240,6 +282,7 @@ def main():
     t0 = time.perf_counter()
     bufs, tail = wire.pack_stream(src, dst, batch, width)
     pack_eps = num_edges / (time.perf_counter() - t0)
+    _PARTIAL["pack_eps"] = round(pack_eps, 1)
     assert tail is None
     stream_bytes = sum(b.nbytes for b in bufs)
     stream = EdgeStream.from_wire(bufs, batch, width, cfg)
@@ -262,6 +305,7 @@ def main():
         elif trace_dir in ("0", "off"):
             trace_dir = None
         device_eps = _device_fold_eps(agg, stream, trace_dir)
+        _PARTIAL["device_eps"] = round(device_eps, 1)
         print(
             f"device-only fold: {device_eps / 1e9:.2f}B edges/s"
             + (f" (trace: {trace_dir})" if trace_dir else ""),
@@ -271,10 +315,19 @@ def main():
         print(f"device fold rate skipped: {e}", file=sys.stderr)
 
     # ---- second BASELINE.json metric: window triangle latency --------------
-    tri_p50 = tri_p95 = None
+    # keys stay present (as null) when skipped — the schema is the contract
+    tri = {
+        "triangle_p50_ms": None,
+        "triangle_p95_ms": None,
+        "triangle_device_p50_ms": None,
+        "triangle_panes_per_sec": None,
+    }
     try:
         if os.environ.get("GELLY_BENCH_TRIANGLES", "1") != "0":
-            tri_p50, tri_p95 = _triangle_latency()
+            tri.update(_triangle_latency())
+            _PARTIAL.update(
+                {k: round(v, 2) for k, v in tri.items() if v is not None}
+            )
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"triangle latency skipped: {e}", file=sys.stderr)
 
@@ -290,7 +343,9 @@ def main():
         # device has actually finished the stream's folds
         jax.block_until_ready((result[-1][0].parent, result[-1][0].seen))
         tpu_trials.append(num_edges / (time.perf_counter() - t0))
+        _PARTIAL["trials"] = [round(t, 1) for t in tpu_trials]
     tpu_eps = statistics.median(tpu_trials)
+    _PARTIAL["value_so_far"] = round(tpu_eps, 1)
     gbps = [round(e * stream_bytes / num_edges / 1e9, 2) for e in tpu_trials]
     spread = min(tpu_trials) / max(tpu_trials)
     print(
@@ -322,6 +377,7 @@ def main():
         r2 = e2e_out.collect()
         jax.block_until_ready((r2[-1][0].parent,))
         e2e_eps = n2 / (time.perf_counter() - t0)
+        _PARTIAL["e2e_eps"] = round(e2e_eps, 1)
         print(
             f"e2e (pack in loop, {n2 >> 20}M edges): {e2e_eps / 1e6:.1f}M eps",
             file=sys.stderr,
@@ -385,8 +441,10 @@ def main():
                 "e2e_eps": round(e2e_eps, 1) if e2e_eps else None,
                 "cpu_baseline_eps": round(cpu_eps, 1) if cpu_eps else None,
                 "device_eps": round(device_eps, 1) if device_eps else None,
-                "triangle_p50_ms": round(tri_p50, 2) if tri_p50 is not None else None,
-                "triangle_p95_ms": round(tri_p95, 2) if tri_p95 is not None else None,
+                **{
+                    key: round(v, 2) if v is not None else None
+                    for key, v in tri.items()
+                },
             }
         )
     )

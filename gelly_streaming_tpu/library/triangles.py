@@ -137,7 +137,9 @@ def _pane_triangle_count(src: np.ndarray, dst: np.ndarray) -> int:
     return _pane_triangle_finish(_pane_triangle_submit(src, dst))
 
 
-def pipelined_pane_counts(panes, recorder=None, warmup: int = 0, depth: int = 2):
+def pipelined_pane_counts(
+    panes, recorder=None, warmup: int = 0, depth: int = 2, device_recorder=None
+):
     """Triangle counts for a sequence of panes with submit/readback overlap.
 
     The sequential loop pays (upload + compute + readback-RTT) per pane; on a
@@ -160,8 +162,18 @@ def pipelined_pane_counts(panes, recorder=None, warmup: int = 0, depth: int = 2)
     two background threads (io/wire.py), so a pane's 4 B/edge wire transfer
     hides under the previous pane's kernel: the measured latency is
     dispatch + MXU compute + readback, not the upload.
+
+    ``device_recorder`` (optional WindowLatencyRecorder) captures the
+    close -> DEVICE-completion interval separately from ``recorder``'s
+    close -> host-visible-result interval.  The two differ by the device->
+    host result delivery: ~tens of microseconds on a PCIe host, but ~40-65 ms
+    through the session tunnel (BASELINE.md) — an environmental floor on the
+    host-visible number that no pipelining removes, while pane *throughput*
+    still pipelines (the async readback of pane k rides under panes k+1..).
     """
     import time as _time
+
+    import jax as _jax
 
     from gelly_streaming_tpu.io.wire import Prefetcher
 
@@ -170,6 +182,12 @@ def pipelined_pane_counts(panes, recorder=None, warmup: int = 0, depth: int = 2)
 
     def drain_one():
         k, t_close, handle = pending.pop(0)
+        if device_recorder is not None and handle[0] != "const":
+            _jax.block_until_ready(handle[1])
+            if k >= warmup:
+                device_recorder.latencies_ms.append(
+                    (_time.perf_counter() - t_close) * 1e3
+                )
         counts.append(_pane_triangle_finish(handle))
         if recorder is not None and k >= warmup:
             recorder.latencies_ms.append((_time.perf_counter() - t_close) * 1e3)
