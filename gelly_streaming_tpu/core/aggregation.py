@@ -29,6 +29,17 @@ from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.core.windows import WindowPane, assign_tumbling_windows
 
 
+@jax.jit
+def _tree_copy(tree):
+    """On-device clone of a pytree.
+
+    Outputs of a jit call never alias its (non-donated) inputs, so the clone
+    stays valid after the caller donates the source to the next fold step —
+    the invariant async snapshots rely on.
+    """
+    return jax.tree.map(jnp.copy, tree)
+
+
 class SummaryAggregation:
     """Abstract aggregation descriptor (SummaryAggregation.java:22-48).
 
@@ -324,20 +335,88 @@ class SummaryAggregation:
             jax.devices()[0],
         )
 
-        def snapshot(pos: int, done: bool, carry_now):
+        # -- asynchronous snapshots (the reference's Merger checkpoints are
+        # also async: Flink's barrier snapshots copy state off the hot path).
+        # A snapshot (a) clones the carry ON DEVICE (a jitted tree copy whose
+        # output cannot alias the non-donated input, so the next fused call's
+        # donation can't corrupt it), (b) starts the device->host copy in the
+        # background, and (c) hands the clone to a writer thread that blocks
+        # on the download and does the atomic save — the fold never waits on
+        # the downlink.  maxsize=1 bounds in-flight clones (backpressure: a
+        # slow disk delays the NEXT snapshot, not the stream).
+        import queue as _queue
+        import threading as _threading
+
+        snap_q: Optional["_queue.Queue"] = None
+        snap_writer: Optional["_threading.Thread"] = None
+        snap_err: list = []
+
+        def _write_snapshots():
             from gelly_streaming_tpu.utils.checkpoint import save_state
 
-            host = jax.tree.map(np.asarray, carry_now)
-            save_state(
-                checkpoint_path,
-                {
-                    "summary": host[1],
-                    "stages": host[0],
-                    "next_batch": np.full((), pos, np.int64),
-                    "batch": np.full((), batch, np.int64),
-                    "done": np.full((), done, bool),
-                },
-            )
+            while True:
+                item = snap_q.get()
+                if item is None:
+                    return
+                pos, done_flag, carry_dev = item
+                try:
+                    host = jax.tree.map(np.asarray, carry_dev)
+                    save_state(
+                        checkpoint_path,
+                        {
+                            "summary": host[1],
+                            "stages": host[0],
+                            "next_batch": np.full((), pos, np.int64),
+                            "batch": np.full((), batch, np.int64),
+                            "done": np.full((), done_flag, bool),
+                        },
+                    )
+                except BaseException as e:  # surfaced on the fold thread
+                    snap_err.append(e)
+                    return
+
+        def _put_snap(item) -> bool:
+            """Bounded put that cannot deadlock against a crashed writer:
+            re-checks the error slot between attempts (the writer may die
+            while this thread is blocked on a full queue)."""
+            while not snap_err:
+                try:
+                    snap_q.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def snapshot(pos: int, done: bool, carry_now):
+            nonlocal snap_q, snap_writer
+            if snap_err:
+                raise snap_err[0]
+            copy = _tree_copy(carry_now)
+            for leaf in jax.tree.leaves(copy):
+                try:
+                    leaf.copy_to_host_async()
+                except AttributeError:
+                    pass
+            if snap_q is None:
+                snap_q = _queue.Queue(maxsize=1)
+                snap_writer = _threading.Thread(target=_write_snapshots, daemon=True)
+                snap_writer.start()
+            if not _put_snap((pos, done, copy)):
+                raise snap_err[0]
+
+        def finish_snapshots(raise_err: bool = True):
+            if snap_q is not None:
+                if _put_snap(None):
+                    snap_writer.join()
+                else:
+                    # dead writer never drains the queue — drop the leftovers
+                    while True:
+                        try:
+                            snap_q.get_nowait()
+                        except _queue.Empty:
+                            break
+            if raise_err and snap_err:
+                raise snap_err[0]
 
         every = cfg.wire_checkpoint_batches
         since_snap = 0
@@ -366,36 +445,44 @@ class SummaryAggregation:
                 for b, _ in pf:
                     yield b
 
-        for i, buf in enumerate(device_buffers()):
-            carry = fused(carry, buf)
-            since_snap += 1
-            if checkpoint_path and every and since_snap >= every:
-                # the snapshot must read the carry BEFORE the next fused
-                # call donates it away
-                snapshot(start_batch + i + 1, False, carry)
-                since_snap = 0
-        if tail_pair is not None:
-            rem = len(tail_pair[0])
-            mask = np.zeros((batch,), bool)
-            mask[:rem] = True
-            pad_s = np.zeros((batch,), np.int32)
-            pad_d = np.zeros((batch,), np.int32)
-            pad_s[:rem] = tail_pair[0]
-            pad_d[:rem] = tail_pair[1]
-            carry = tail(
-                carry,
-                jnp.asarray(pad_s),
-                jnp.asarray(pad_d),
-                jnp.asarray(mask),
-            )
-        if total_edges == 0:
-            return
-        out = self.transform(carry[1])
-        # emit BEFORE the final snapshot: a crash between the two re-emits on
-        # recovery (at-least-once) instead of dropping the record
-        yield out if isinstance(out, tuple) else (out,)
-        if checkpoint_path:
-            snapshot(n_full, True, carry)
+        try:
+            for i, buf in enumerate(device_buffers()):
+                carry = fused(carry, buf)
+                since_snap += 1
+                if checkpoint_path and every and since_snap >= every:
+                    # the snapshot clones the carry on device BEFORE the next
+                    # fused call donates it away
+                    snapshot(start_batch + i + 1, False, carry)
+                    since_snap = 0
+            if tail_pair is not None:
+                rem = len(tail_pair[0])
+                mask = np.zeros((batch,), bool)
+                mask[:rem] = True
+                pad_s = np.zeros((batch,), np.int32)
+                pad_d = np.zeros((batch,), np.int32)
+                pad_s[:rem] = tail_pair[0]
+                pad_d[:rem] = tail_pair[1]
+                carry = tail(
+                    carry,
+                    jnp.asarray(pad_s),
+                    jnp.asarray(pad_d),
+                    jnp.asarray(mask),
+                )
+            if total_edges == 0:
+                return
+            out = self.transform(carry[1])
+            # emit BEFORE the final snapshot: a crash between the two
+            # re-emits on recovery (at-least-once) instead of dropping the
+            # record
+            yield out if isinstance(out, tuple) else (out,)
+            if checkpoint_path:
+                snapshot(n_full, True, carry)
+        except BaseException:
+            # includes GeneratorExit from an abandoning consumer: shut the
+            # writer down without masking the in-flight exception
+            finish_snapshots(raise_err=False)
+            raise
+        finish_snapshots()
 
     def _checkpoint_like(self, cfg):
         """Checkpoint structure: summary + presence flag + stream position.

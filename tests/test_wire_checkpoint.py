@@ -305,3 +305,50 @@ def test_wire_checkpoint_resumes_across_encodings(tmp_path):
         .collect()
     )
     assert int(out[0][0]) == 1024  # exactly-once across the encoding switch
+
+
+def test_wire_checkpoint_async_writer_backpressure(tmp_path, monkeypatch):
+    """Snapshots are written OFF the fold thread (async barrier-snapshot
+    analog); a slow sink must backpressure snapshots without corrupting the
+    final state or losing the terminal snapshot."""
+    import time
+
+    src, dst = _edges()
+    cfg = _cfg(tmp_path, every=2)  # 16 snapshots over 32 batches
+    path = str(tmp_path / "ck")
+
+    import gelly_streaming_tpu.utils.checkpoint as ckpt
+
+    real_save = ckpt.save_state
+    calls = []
+
+    def slow_save(p, state):
+        time.sleep(0.02)  # slower than the fold produces snapshots
+        calls.append(int(state["next_batch"]))
+        real_save(p, state)
+
+    monkeypatch.setattr(ckpt, "save_state", slow_save)
+    out = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents(), checkpoint_path=path)
+        .collect()
+    )
+    monkeypatch.setattr(ckpt, "save_state", real_save)
+    # every snapshot position is monotonically increasing and the terminal
+    # snapshot (done=True, position 32) landed despite the slow sink
+    assert calls == sorted(calls)
+    assert calls[-1] == 32
+    from gelly_streaming_tpu.utils.checkpoint import load_state
+
+    agg = ConnectedComponents()
+    stream = EdgeStream.from_arrays(src, dst, cfg)
+    snap = load_state(path, agg._wire_checkpoint_like(stream))
+    assert bool(snap["done"])
+    clean = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(snap["summary"].parent), np.asarray(clean[-1][0].parent)
+    )
