@@ -213,3 +213,22 @@ def test_replay_checkpoint_crash_resume(tmp_path, monkeypatch):
         np.asarray(jax.jit(uf.compress)(resumed[-1][0].parent)),
         np.asarray(jax.jit(uf.compress)(clean[-1][0].parent)),
     )
+
+
+def test_replay_on_the_mesh_path():
+    """A replay stream with num_shards > 1 is not wire-eligible (the fast
+    path is single-partition); it must flow through the mesh runner via the
+    host decode and still produce exact labels."""
+    capacity = 1 << 10
+    src, dst = _edges(4096, capacity, seed=11)
+    cfg = StreamConfig(vertex_capacity=capacity, batch_size=1024, num_shards=4)
+    width = (wire.EF40, capacity)
+    bufs, tail = wire.pack_stream(src, dst, 1024, width)
+    stream = EdgeStream.from_wire(bufs, 1024, width, cfg, tail=tail)
+    agg = ConnectedComponents()
+    assert not agg._wire_eligible(stream)
+    import jax
+
+    out = stream.aggregate(agg).collect()
+    got = np.asarray(jax.jit(uf.compress)(out[-1][0].parent))
+    assert np.array_equal(got, host_min_labels(capacity, src, dst))
