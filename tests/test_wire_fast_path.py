@@ -199,14 +199,11 @@ def test_wire_ef40_bipartiteness_matches_plain():
         assert str(plain[-1][0]) == str(ef[-1][0])
 
 
-def test_aggregate_strategy_selection_matrix(monkeypatch):
-    """run() picks wire / mesh / simulated correctly, including with
-    checkpointing (the wire path no longer opts out)."""
+def _spy_strategies(monkeypatch):
+    """Instrument run()'s strategy selection; returns the call log."""
     import gelly_streaming_tpu.core.aggregation as agg_mod
 
-    src, dst = _random_edges(n=128, c=32)
     calls = []
-
     orig_wire = agg_mod.SummaryAggregation._wire_records
     orig_mesh = agg_mod.MeshAggregationRunner.run
 
@@ -220,6 +217,14 @@ def test_aggregate_strategy_selection_matrix(monkeypatch):
 
     monkeypatch.setattr(agg_mod.SummaryAggregation, "_wire_records", spy_wire)
     monkeypatch.setattr(agg_mod.MeshAggregationRunner, "run", spy_mesh)
+    return calls
+
+
+def test_aggregate_strategy_selection_matrix(monkeypatch):
+    """run() picks wire / mesh / simulated correctly, including with
+    checkpointing (the wire path no longer opts out)."""
+    src, dst = _random_edges(n=128, c=32)
+    calls = _spy_strategies(monkeypatch)
 
     single = StreamConfig(vertex_capacity=32, batch_size=64)
     sharded = StreamConfig(vertex_capacity=32, batch_size=64, num_shards=8)
@@ -249,3 +254,36 @@ def test_aggregate_strategy_selection_matrix(monkeypatch):
         list(zip(src.tolist(), dst.tolist())), single, 64
     ).aggregate(ConnectedComponents()).collect()
     assert calls == []  # simulated path: neither wire nor mesh
+
+
+def test_aggregate_strategy_selection_replay(monkeypatch):
+    """from_wire replay streams select the same strategies as from_arrays:
+    wire fast path single-shard (with or without checkpointing), mesh when
+    sharded."""
+    import tempfile
+
+    from gelly_streaming_tpu.io import wire as wire_mod
+
+    src, dst = _random_edges(n=128, c=32)
+    calls = _spy_strategies(monkeypatch)
+    bufs, tail = wire_mod.pack_stream(src, dst, 64, 2)
+    single = StreamConfig(vertex_capacity=32, batch_size=64)
+    sharded = StreamConfig(vertex_capacity=32, batch_size=64, num_shards=8)
+
+    EdgeStream.from_wire(bufs, 64, 2, single, tail=tail).aggregate(
+        ConnectedComponents()
+    ).collect()
+    assert calls == ["wire"]
+
+    calls.clear()
+    with tempfile.TemporaryDirectory() as d:
+        EdgeStream.from_wire(bufs, 64, 2, single, tail=tail).aggregate(
+            ConnectedComponents(), checkpoint_path=f"{d}/ck"
+        ).collect()
+    assert calls == ["wire"]
+
+    calls.clear()
+    EdgeStream.from_wire(bufs, 64, 2, sharded, tail=tail).aggregate(
+        ConnectedComponents()
+    ).collect()
+    assert calls == ["mesh"]

@@ -79,3 +79,35 @@ def test_wire_cc_crash_resume_fuzz(tmp_path, monkeypatch, crash_after, enc):
     )
     labels = np.asarray(jax.jit(uf.compress)(out[-1][0].parent))
     np.testing.assert_array_equal(labels, _host_min_labels(cap, src, dst))
+
+
+REPLAY_CASES = [
+    # (n_edges, capacity, batch, width_kind)
+    (257, 1 << 6, 64, "bytes"),    # width-2, tail
+    (512, 1 << 6, 64, "ef40"),     # EF40, exact batches
+    (999, 1 << 10, 128, "ef40"),   # EF40 with tail
+    (300, (1 << 20) + 8, 64, "bytes"),  # width-3 (capacity > 2^20)
+    (64, 1 << 18, 64, "pair40"),   # pair40, single batch
+]
+
+
+@pytest.mark.parametrize("n,cap,batch,kind", REPLAY_CASES)
+def test_replay_cc_matches_host_union_find(n, cap, batch, kind):
+    """The replay source under the same configuration sweep as from_arrays."""
+    from gelly_streaming_tpu.io import wire as wire_mod
+
+    rng = np.random.default_rng(n * 13 + cap)
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    width = {
+        "bytes": wire_mod.width_for_capacity(cap),
+        "pair40": wire_mod.PAIR40,
+        "ef40": (wire_mod.EF40, cap),
+    }[kind]
+    bufs, tail = wire_mod.pack_stream(src, dst, batch, width)
+    cfg = StreamConfig(vertex_capacity=cap, batch_size=batch)
+    out = EdgeStream.from_wire(bufs, batch, width, cfg, tail=tail).aggregate(
+        ConnectedComponents()
+    )
+    labels = np.asarray(jax.jit(uf.compress)(out.collect()[-1][0].parent))
+    np.testing.assert_array_equal(labels, _host_min_labels(cap, src, dst))
