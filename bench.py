@@ -279,11 +279,7 @@ def main():
     agg = ConnectedComponents()
     # CC's fold is order-free, so the replay stream ships the EF40 sorted
     # multiset (~2.7 B/edge) when ids fit 20 bits, else the plain pack
-    width = (
-        (wire.EF40, capacity)
-        if capacity <= 1 << 20
-        else wire.width_for_capacity(capacity)
-    )
+    width = wire.replay_width(capacity)
 
     # ---- producer cost (untimed for the replay metric, reported) -----------
     t0 = time.perf_counter()

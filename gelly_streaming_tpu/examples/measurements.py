@@ -20,7 +20,9 @@ Options: --edges N --vertices C --batch B --seed S; triangles also takes
 the MXU kernel; reports p50/p95 per-window latency); spanner adds
 --max-degree D --k K (two-phase batch admission, reports edges/s and the
 admitted spanner size); matching reports the reference's net-runtime metric
-(CentralizedWeightedMatching.java:62-64) plus edges/s.
+(CentralizedWeightedMatching.java:62-64) plus edges/s; replay drives the
+wire-replay CC headline (EdgeStream.from_wire) and reports replay/pack
+rates plus the encoding's bytes per edge.
 """
 
 from __future__ import annotations
@@ -194,6 +196,59 @@ def measure_spanner(args) -> dict:
     }
 
 
+def measure_replay(args) -> dict:
+    """Wire-replay connected components: the bench.py headline through the
+    product API (EdgeStream.from_wire -> aggregate(CC)), sized by argv.
+
+    Reports the replay fold rate (transfer + device unpack + union-find),
+    the producer-side pack rate, and the encoding's bytes/edge — the three
+    numbers that characterize the ingest plane on any host (BASELINE.md's
+    environment model explains what bounds each on the session tunnel).
+    """
+    import time
+
+    import jax
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.io import wire
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    n = args.edges - args.edges % args.batch  # full batches: all-wire stream
+    if n == 0:
+        raise SystemExit("--edges must be at least one full --batch")
+    src = rng.integers(0, args.vertices, n).astype(np.int32)
+    dst = rng.integers(0, args.vertices, n).astype(np.int32)
+    width = wire.replay_width(args.vertices)  # CC's fold is order-free
+    t0 = time.perf_counter()
+    bufs, _ = wire.pack_stream(src, dst, args.batch, width)
+    pack_eps = n / (time.perf_counter() - t0)
+    cfg = StreamConfig(vertex_capacity=args.vertices, batch_size=args.batch)
+    agg = ConnectedComponents()
+    out = EdgeStream.from_wire(bufs, args.batch, width, cfg).aggregate(agg)
+    # one-buffer prefix compiles the identical fused step without replaying
+    # (and re-transferring) the whole stream
+    EdgeStream.from_wire(bufs[:1], args.batch, width, cfg).aggregate(
+        agg
+    ).collect()
+    t0 = time.perf_counter()
+    r = out.collect()
+    jax.block_until_ready((r[-1][0].parent, r[-1][0].seen))
+    dt = time.perf_counter() - t0
+    nbytes = sum(b.nbytes for b in bufs)
+    return {
+        "workload": "wire_replay_cc",
+        "edges": int(n),
+        "replay_eps": round(n / dt, 1),
+        "pack_eps": round(pack_eps, 1),
+        "bytes_per_edge": round(nbytes / n, 2),
+        "wire_gbps": round(nbytes / dt / 1e9, 3),
+    }
+
+
 def measure_matching(args) -> dict:
     """Centralized greedy weighted-matching net runtime — the single
     measurement the reference itself ships (CentralizedWeightedMatching.java:
@@ -266,6 +321,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--vertices", type=int, default=1 << 12)
     sp.add_argument("--batch", type=int, default=1 << 13)
     sp.add_argument("--seed", type=int, default=0)
+    sp = sub.add_parser("replay")
+    sp.add_argument("--edges", type=int, default=1 << 22)
+    sp.add_argument("--vertices", type=int, default=1 << 20)
+    sp.add_argument("--batch", type=int, default=1 << 20)
+    sp.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     fn = {
         "degrees": measure_degrees,
@@ -273,6 +333,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "triangles": measure_triangles,
         "spanner": measure_spanner,
         "matching": measure_matching,
+        "replay": measure_replay,
     }[args.workload]
     print(json.dumps(fn(args)))
 

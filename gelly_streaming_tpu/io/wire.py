@@ -237,6 +237,15 @@ def unpack_edges(wire, n: int, width, xp=None):
     return v[0], v[1]
 
 
+def replay_width(capacity: int, order_free: bool = True):
+    """Encoding policy for a replay producer: the EF40 sorted multiset when
+    the consumer's fold is order-free and ids fit 20 bits (the fewest bytes
+    per edge), else the tightest fixed-width encoding."""
+    if order_free and capacity <= 1 << 20:
+        return (EF40, capacity)
+    return width_for_capacity(capacity)
+
+
 def pack_stream(
     src: np.ndarray, dst: np.ndarray, batch: int, width
 ) -> Tuple[list, Optional[Tuple[np.ndarray, np.ndarray]]]:

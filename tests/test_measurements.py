@@ -92,3 +92,15 @@ def test_spanner_measurement(capsys):
     assert out["workload"] == "spanner"
     assert 0 < out["spanner_edges"] <= 2048
     assert out["edges_per_sec"] > 0
+
+
+def test_replay_measurement(capsys):
+    out = _run(
+        ["replay", "--edges", "4096", "--vertices", "512", "--batch", "1024"],
+        capsys,
+    )
+    assert out["workload"] == "wire_replay_cc"
+    assert out["edges"] == 4096
+    assert out["replay_eps"] > 0 and out["pack_eps"] > 0
+    # EF40 at this capacity beats the 5 B/edge plain pack
+    assert out["bytes_per_edge"] < 5
