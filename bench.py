@@ -277,9 +277,11 @@ def main():
         vertex_capacity=capacity, batch_size=batch, wire_checkpoint_batches=2
     )
     agg = ConnectedComponents()
-    # CC's fold is order-free, so the replay stream ships the EF40 sorted
-    # multiset (~2.7 B/edge) when ids fit 20 bits, else the plain pack
-    width = wire.replay_width(capacity)
+    # CC's fold is order-free, so the replay stream ships whichever legal
+    # encoding is fewest bytes at this (capacity, batch) — EF40's ~2.7
+    # B/edge at the defaults; fixed-width when capacity >> batch or ids
+    # exceed 20 bits (io.wire.replay_width)
+    width = wire.replay_width(capacity, batch)
 
     # ---- producer cost (untimed for the replay metric, reported) -----------
     t0 = time.perf_counter()

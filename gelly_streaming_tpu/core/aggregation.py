@@ -192,15 +192,13 @@ class SummaryAggregation:
                 cores = len(os.sched_getaffinity(0))
             except AttributeError:
                 cores = os.cpu_count() or 1
-            enc = (
-                "ef40"
-                if (
-                    self.order_free
-                    and cfg.vertex_capacity <= 1 << 20
-                    and cores >= 2
-                )
-                else "plain"
+            # one shared cost policy with the replay producer: EF40 only
+            # when it actually ships fewer bytes at this (capacity, batch) —
+            # its per-batch bitvector dominates when capacity >> batch
+            width = wire.replay_width(
+                cfg.vertex_capacity, cfg.batch_size, self.order_free
             )
+            enc = "ef40" if (cores >= 2 and isinstance(width, tuple)) else "plain"
         if enc == "ef40":
             if not self.order_free:
                 raise ValueError(

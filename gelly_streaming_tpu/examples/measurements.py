@@ -222,7 +222,7 @@ def measure_replay(args) -> dict:
         raise SystemExit("--edges must be at least one full --batch")
     src = rng.integers(0, args.vertices, n).astype(np.int32)
     dst = rng.integers(0, args.vertices, n).astype(np.int32)
-    width = wire.replay_width(args.vertices)  # CC's fold is order-free
+    width = wire.replay_width(args.vertices, args.batch)  # CC is order-free
     t0 = time.perf_counter()
     bufs, _ = wire.pack_stream(src, dst, args.batch, width)
     pack_eps = n / (time.perf_counter() - t0)

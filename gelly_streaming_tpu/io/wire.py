@@ -237,13 +237,23 @@ def unpack_edges(wire, n: int, width, xp=None):
     return v[0], v[1]
 
 
-def replay_width(capacity: int, order_free: bool = True):
-    """Encoding policy for a replay producer: the EF40 sorted multiset when
-    the consumer's fold is order-free and ids fit 20 bits (the fewest bytes
-    per edge), else the tightest fixed-width encoding."""
-    if order_free and capacity <= 1 << 20:
+def replay_width(capacity: int, batch: int, order_free: bool = True):
+    """Encoding policy for a replay producer: whichever legal encoding ships
+    the fewest wire bytes for this (capacity, batch).
+
+    EF40 is only legal for order-free folds with ids in 20 bits, and only
+    *smaller* when its per-batch unary bitvector ((batch + capacity)/8 B) is
+    outweighed by the 2.5 B/edge dst stream — i.e. capacity small relative
+    to batch; for capacity >> batch the fixed-width pack wins despite its 5
+    B/edge."""
+    fixed = width_for_capacity(capacity)
+    if (
+        order_free
+        and capacity <= 1 << 20
+        and ef40_nbytes(batch, capacity) < wire_nbytes(batch, fixed)
+    ):
         return (EF40, capacity)
-    return width_for_capacity(capacity)
+    return fixed
 
 
 def pack_stream(

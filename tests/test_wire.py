@@ -216,3 +216,26 @@ def test_records48_roundtrip():
     np.testing.assert_array_equal(i2, ids)
     np.testing.assert_array_equal(v2, vals)
     np.testing.assert_array_equal(m2, mask)
+
+
+def test_replay_width_picks_cheapest_legal_encoding():
+    """EF40 only wins while its per-batch bitvector is outweighed by the
+    2.5 B/edge dst stream; capacity >> batch must fall back to fixed width."""
+    from gelly_streaming_tpu.io import wire
+
+    # capacity small vs batch: EF40 strictly smaller
+    assert wire.replay_width(1 << 10, 4096) == (wire.EF40, 1 << 10)
+    # capacity 2^20 with a tiny batch: the bitvector alone is ~32 B/edge
+    assert wire.replay_width(1 << 20, 4096) == wire.PAIR40
+    # order-sensitive folds never get the multiset encoding
+    assert wire.replay_width(1 << 10, 4096, order_free=False) == 2
+    # ids beyond 20 bits: EF40 illegal regardless
+    assert wire.replay_width((1 << 20) + 1, 1 << 22) == 3
+    # the chosen encoding really is the cheaper of the two at the boundary
+    for cap, batch in [(1 << 16, 1 << 14), (1 << 20, 1 << 21), (1 << 18, 1 << 16)]:
+        w = wire.replay_width(cap, batch)
+        fixed = wire.width_for_capacity(cap)
+        best = min(
+            wire.wire_nbytes(batch, fixed), wire.ef40_nbytes(batch, cap)
+        )
+        assert wire.wire_nbytes(batch, w) == best

@@ -232,3 +232,23 @@ def test_replay_on_the_mesh_path():
     out = stream.aggregate(agg).collect()
     got = np.asarray(jax.jit(uf.compress)(out[-1][0].parent))
     assert np.array_equal(got, host_min_labels(capacity, src, dst))
+
+
+def test_replay_feeds_block_sharded_cc():
+    """The O(C/S) block-distributed CC plane consumes a wire-replay stream
+    (panes come from the factory's host decode) and still matches the host
+    union-find exactly — replay composes with the scale-out label plane."""
+    from gelly_streaming_tpu.library.connected_components import (
+        BlockShardedCC,
+        unshard_labels,
+    )
+
+    capacity = 1 << 10
+    src, dst = _edges(3000, capacity, seed=21)
+    cfg = StreamConfig(vertex_capacity=capacity, batch_size=512)
+    width = (wire.EF40, capacity)
+    bufs, tail = wire.pack_stream(src, dst, 512, width)
+    stream = EdgeStream.from_wire(bufs, 512, width, cfg, tail=tail)
+    outs = list(BlockShardedCC().run(stream))
+    labels = unshard_labels(outs[-1][0])
+    assert np.array_equal(labels, host_min_labels(capacity, src, dst))
