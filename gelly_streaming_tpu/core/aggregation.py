@@ -172,14 +172,18 @@ class SummaryAggregation:
         cache[key] = entry
         return entry
 
-    def _wire_width(self, cfg: StreamConfig):
+    def _wire_width(self, cfg: StreamConfig, batch: Optional[int] = None):
         """Resolve the wire encoding for this descriptor + config.
 
         "auto" picks EF40 (sorted multiset, ~2x fewer bytes) only when the
-        descriptor is order-free, ids fit in 20 bits, and the host has spare
+        descriptor is order-free, ids fit in 20 bits, the host has spare
         cores to sort on — on a single-core host the per-batch radix sort
         competes with the transfer path for the same CPU and measures slower
-        than shipping the plain 40-bit pack (BASELINE.md round 3).
+        than shipping the plain 40-bit pack (BASELINE.md round 3) — AND it
+        actually ships fewer bytes at the EFFECTIVE batch size (``batch``,
+        defaulting to cfg.batch_size): its per-batch unary bitvector
+        dominates when capacity >> batch, e.g. a short stream whose single
+        batch shrank to the stream length.
         """
         from gelly_streaming_tpu.io import wire
 
@@ -192,11 +196,11 @@ class SummaryAggregation:
                 cores = len(os.sched_getaffinity(0))
             except AttributeError:
                 cores = os.cpu_count() or 1
-            # one shared cost policy with the replay producer: EF40 only
-            # when it actually ships fewer bytes at this (capacity, batch) —
-            # its per-batch bitvector dominates when capacity >> batch
+            # one shared cost policy with the replay producer
             width = wire.replay_width(
-                cfg.vertex_capacity, cfg.batch_size, self.order_free
+                cfg.vertex_capacity,
+                batch if batch is not None else cfg.batch_size,
+                self.order_free,
             )
             enc = "ef40" if (cores >= 2 and isinstance(width, tuple)) else "plain"
         if enc == "ef40":
@@ -305,7 +309,7 @@ class SummaryAggregation:
         else:
             src, dst, batch = stream._wire_arrays
             batch = min(batch, max(len(src), 1))
-            width = self._wire_width(cfg)
+            width = self._wire_width(cfg, batch)
             n_full = len(src) // batch
             rem = len(src) - n_full * batch
             tail_pair = (

@@ -143,16 +143,35 @@ class JaxWatermarkBoard:
     ``allgather`` is a collective — every participating process must call it
     once per round (``lockstep_tumbling_windows`` guarantees that cadence,
     END-padding hosts whose streams end early).
+
+    Watermarks cross the collective as int64 under a local ``enable_x64``
+    scope: the framework runs with x64 DISABLED (all kernels are int32), so
+    a bare process_allgather would silently canonicalize the int64 marks to
+    int32 — truncating the END sentinel (int64 max) to -1, which makes the
+    END-agreement test unreachable and spins every host in the shutdown
+    phase forever.  Caught by the real two-process jax.distributed test
+    (tests/test_multihost_distributed.py); the in-process transports never
+    jit, so they cannot see it.
     """
 
     def allgather(self, local_watermark: int) -> np.ndarray:
+        import jax
         from jax.experimental import multihost_utils
 
-        return np.atleast_1d(
-            multihost_utils.process_allgather(
+        with jax.enable_x64(True):
+            out = multihost_utils.process_allgather(
                 np.asarray(local_watermark, np.int64)
             )
-        )
+        out = np.atleast_1d(np.asarray(out))
+        if out.dtype != np.int64:
+            # a canonicalized (int32) result means END came back as -1 — a
+            # value indistinguishable from the legitimate 'no data yet' mark,
+            # so the ONLY reliable regression guard is the dtype itself
+            raise RuntimeError(
+                f"watermark transport canonicalized int64 marks to {out.dtype};"
+                " END-agreement would never terminate"
+            )
+        return out
 
 
 def _default_on_late(pane_id: int, count: int) -> None:

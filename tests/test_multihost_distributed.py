@@ -1,0 +1,124 @@
+"""REAL multi-process watermark agreement over jax.distributed.
+
+tests/test_multihost.py exercises the lockstep transport with in-process
+thread barriers; this module runs the actual production transport — TWO
+OS processes forming a jax.distributed CPU cluster, one
+``multihost_utils.process_allgather`` round per ingested batch
+(``JaxWatermarkBoard``) — the DCN path a real multi-host TPU job uses.
+Unequal batch counts exercise the END-padding protocol: the short host must
+keep joining rounds until every host reports END, and both hosts must close
+the identical pane-id sequence.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, %(repo)r)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from gelly_streaming_tpu.parallel import multihost as mh
+
+    coord, pid = sys.argv[1], int(sys.argv[2])
+    env = mh.distributed_env(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    assert (env.host_id, env.num_hosts) == (pid, 2), env
+
+    import numpy as np
+
+    from gelly_streaming_tpu.core.types import EdgeBatch
+
+    # host 0 ingests windows 0..4; host 1 only 1..2 (END-padding path)
+    wids = [0, 1, 2, 3, 4] if pid == 0 else [1, 2]
+
+    def batches():
+        for w in wids:
+            t = np.array([w * 100 + 5], np.int64)
+            yield EdgeBatch.from_arrays(
+                np.array([pid * 10 + w], np.int32),
+                np.array([w], np.int32),
+                time=t,
+            )
+
+    board = mh.JaxWatermarkBoard()
+    out = []
+    for pane in mh.lockstep_tumbling_windows(
+        batches(), 100, board.allgather, timeout=60.0
+    ):
+        out.append(
+            {
+                "wid": int(pane.window_id),
+                "src": np.asarray(pane.src).tolist(),
+            }
+        )
+    print("RESULT " + json.dumps(out), flush=True)
+    """
+)
+
+
+def test_two_process_jax_distributed_lockstep(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers don't need the virtual 8-dev mesh
+
+    # stdout/stderr go to FILES: piping would deadlock (the parent drains one
+    # worker's pipes while the other blocks on a full pipe, which stalls the
+    # collective both are inside)
+    logs = []
+    procs = []
+    for pid in (0, 1):
+        out_f = open(tmp_path / f"w{pid}.out", "w+")
+        err_f = open(tmp_path / f"w{pid}.err", "w+")
+        logs.append((out_f, err_f))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER % {"repo": REPO}, coord, str(pid)],
+                stdout=out_f,
+                stderr=err_f,
+                env=env,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            p.wait(timeout=180)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    for p, (out_f, err_f) in zip(procs, logs):
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout, stderr = out_f.read(), err_f.read()
+        out_f.close()
+        err_f.close()
+        assert p.returncode == 0, stderr[-2000:]
+        line = [l for l in stdout.splitlines() if l.startswith("RESULT ")][-1]
+        outs.append(json.loads(line[len("RESULT ") :]))
+
+    # identical pane-id sequences on both hosts (the lockstep contract),
+    # covering the union of both hosts' windows
+    assert [p["wid"] for p in outs[0]] == [p["wid"] for p in outs[1]]
+    assert [p["wid"] for p in outs[0]] == [0, 1, 2, 3, 4]
+    # each host's pane carries exactly its own local share
+    for pid, out in enumerate(outs):
+        wids = [0, 1, 2, 3, 4] if pid == 0 else [1, 2]
+        for pane in out:
+            expect = [pid * 10 + pane["wid"]] if pane["wid"] in wids else []
+            assert pane["src"] == expect, (pid, pane)
