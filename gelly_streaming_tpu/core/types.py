@@ -81,7 +81,23 @@ class EdgeBatch:
             val = jax.tree.map(jnp.asarray, val)
         if time is not None:
             # Relative stream time in ms (int32): windows are assigned on the
-            # host, so device timestamps only need to order events within a run.
+            # host, so device timestamps only need to order events within a
+            # run.  Epoch-scale timestamps (~1.7e12 ms) would silently WRAP
+            # in the cast — fail loudly instead (same philosophy as the
+            # vertex-id bounds check in EdgeStream.from_arrays): rebase to
+            # stream-relative ms first.
+            if not isinstance(time, jax.core.Tracer):  # host arrays only:
+                # traced construction (e.g. inside a jitted step) stays legal
+                t_host = np.asarray(time)
+                if t_host.size and (
+                    t_host.max() > np.iinfo(np.int32).max
+                    or t_host.min() < np.iinfo(np.int32).min
+                ):
+                    raise ValueError(
+                        "event timestamps must be stream-relative ms fitting "
+                        "int32; rebase epoch timestamps (subtract the stream "
+                        "start) before ingest — host owns time"
+                    )
             time = jnp.asarray(time, dtype=jnp.int32)
         if sign is not None:
             sign = jnp.asarray(sign, dtype=jnp.int8)
@@ -116,7 +132,9 @@ class EdgeBatch:
             else:
                 val = np.array([e[2] for e in edges])
         if with_time and len(edges[0]) > 3:
-            time = np.array([e[3] for e in edges], dtype=np.int32)
+            # int64 here so from_arrays' epoch-overflow guard sees the raw
+            # values (an int32 build would wrap or raise before it runs)
+            time = np.array([e[3] for e in edges], dtype=np.int64)
         return EdgeBatch.from_arrays(src, dst, val=val, time=time, pad_to=pad_to)
 
     # ---- shape/padding ------------------------------------------------------

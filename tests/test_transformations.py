@@ -134,3 +134,48 @@ def test_transformations_batch_size_invariant():
         assert_lines(
             stream.edges_csv_lines(), "2,3,23\n3,4,34\n3,5,35\n4,5,45\n5,1,51"
         )
+
+
+def test_epoch_timestamps_fail_loudly():
+    """Epoch-ms timestamps exceed int32 and would silently wrap in the
+    device cast; the constructor must refuse them (host owns time —
+    rebase to stream-relative ms)."""
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu.core.types import EdgeBatch
+
+    epoch_ms = np.array([1_785_000_000_000], np.int64)
+    with pytest.raises(ValueError, match="rebase"):
+        EdgeBatch.from_arrays(
+            np.array([1], np.int32), np.array([2], np.int32), time=epoch_ms
+        )
+    # relative times are fine
+    b = EdgeBatch.from_arrays(
+        np.array([1], np.int32),
+        np.array([2], np.int32),
+        time=np.array([12345], np.int64),
+    )
+    assert int(b.time[0]) == 12345
+
+
+def test_epoch_timestamps_guard_covers_from_edges_and_tracers():
+    import jax
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu.core.types import EdgeBatch
+
+    with pytest.raises(ValueError, match="rebase"):
+        EdgeBatch.from_edges(
+            [(1, 2, 0.0, 1_785_000_000_000)], with_time=True
+        )
+    # traced construction stays legal (wire steps build batches inside jit)
+    src = np.array([1], np.int32)
+    dst = np.array([2], np.int32)
+
+    def build(t):
+        return EdgeBatch.from_arrays(src, dst, time=t).time
+
+    out = jax.jit(build)(np.array([7], np.int64))
+    assert int(out[0]) == 7
