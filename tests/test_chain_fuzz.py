@@ -1,0 +1,79 @@
+"""Differential fuzz: random transformation chains, wire path vs simulated.
+
+The packed-wire fast path runs the SAME stage pipeline as the simulated
+runtime but inside one fused jitted step after a device-side unpack
+(core/aggregation.py).  A divergence between the two executions of an
+identical chain is a fast-path bug by definition — this sweep composes
+random chains of map/filter/reverse/undirected/distinct over seeded random
+edge streams and asserts both paths produce identical CC labels and edge
+counts.  (from_collection never exposes wire arrays, so it always takes the
+simulated path; from_arrays rides the wire.)
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+from gelly_streaming_tpu.ops import unionfind as uf
+
+
+CAP = 64
+
+# (name, stream -> stream); predicates/maps are jax-traceable and pure
+CHAIN_OPS = [
+    ("rev", lambda s: s.reverse()),
+    ("und", lambda s: s.undirected()),
+    ("dis", lambda s: s.distinct()),
+    ("fe_mod", lambda s: s.filter_edges(lambda a, b, v: (a + b) % 3 != 0)),
+    ("fv_half", lambda s: s.filter_vertices(lambda v: v < CAP // 2)),
+    ("fe_ne", lambda s: s.filter_edges(lambda a, b, v: a != b)),
+    # map sets batch.val on both paths (the wire unpack constructs val=None;
+    # a fused-step divergence in valued batches would surface here)
+    ("map_sum", lambda s: s.map_edges(lambda a, b, v: a + b)),
+]
+
+
+_compress_j = jax.jit(uf.compress)
+
+
+def _labels(out):
+    return np.asarray(_compress_j(out[-1][0].parent))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_chain_wire_matches_simulated(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(50, 400))
+    src = rng.integers(0, CAP, n).astype(np.int32)
+    dst = rng.integers(0, CAP, n).astype(np.int32)
+    batch = int(rng.choice([16, 32, 64]))
+    ops = [CHAIN_OPS[i] for i in rng.choice(len(CHAIN_OPS), rng.integers(0, 4))]
+
+    cfg = StreamConfig(vertex_capacity=CAP, batch_size=batch)
+    wire_stream = EdgeStream.from_arrays(src, dst, cfg)
+    sim_stream = EdgeStream.from_collection(
+        list(zip(src.tolist(), dst.tolist())), cfg, batch_size=batch
+    )
+    for _, op in ops:
+        wire_stream = op(wire_stream)
+        sim_stream = op(sim_stream)
+
+    agg = ConnectedComponents()
+    assert agg._wire_eligible(wire_stream)
+    assert not agg._wire_eligible(sim_stream)
+    wire_out = wire_stream.aggregate(ConnectedComponents()).collect()
+    sim_out = sim_stream.aggregate(ConnectedComponents()).collect()
+    names = [name for name, _ in ops]
+    np.testing.assert_array_equal(
+        _labels(wire_out), _labels(sim_out), err_msg=f"chain={names}"
+    )
+    # seen-vertex sets must also agree (CC labels alone can mask drops)
+    np.testing.assert_array_equal(
+        np.asarray(wire_out[-1][0].seen),
+        np.asarray(sim_out[-1][0].seen),
+        err_msg=f"chain={names}",
+    )
