@@ -50,7 +50,7 @@ the pipelined pane runner.
 Scale knobs via env: GELLY_BENCH_EDGES (default 16M), GELLY_BENCH_VERTICES
 (default 2^20), GELLY_BENCH_BATCH (default 2^21 edges -> ~5.4 MB EF40
 buffers), GELLY_BENCH_TRIALS (3), GELLY_BENCH_SETTLE_MAX (max seconds to wait
-for the burst budget before each trial, 180), GELLY_BENCH_E2E_EDGES (default
+for the burst budget before each trial, 120), GELLY_BENCH_E2E_EDGES (default
 8M — volume for the pack-in-loop secondary metric).
 """
 
@@ -237,7 +237,7 @@ def main():
     capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
     batch = int(os.environ.get("GELLY_BENCH_BATCH", 1 << 21))
     trials = max(1, int(os.environ.get("GELLY_BENCH_TRIALS", 3)))
-    settle_max = float(os.environ.get("GELLY_BENCH_SETTLE_MAX", 180.0))
+    settle_max = float(os.environ.get("GELLY_BENCH_SETTLE_MAX", 120.0))
     e2e_edges = int(os.environ.get("GELLY_BENCH_E2E_EDGES", 1 << 23))
     batch = min(batch, num_edges)
     # a full-batch stream keeps every timed transfer in wire format (a raw
@@ -263,7 +263,7 @@ def main():
     # a second watchdog bounds the WHOLE bench: a tunnel wedge mid-run would
     # otherwise hang a collect() forever and leave the driver artifact-less
     _watchdog(
-        float(os.environ.get("GELLY_BENCH_DEADLINE", 1800)), "bench run", 4
+        float(os.environ.get("GELLY_BENCH_DEADLINE", 1500)), "bench run", 4
     )
 
     rng = np.random.default_rng(0)
