@@ -151,3 +151,40 @@ def test_apply_on_neighbors_sharded(direction, golden):
         .apply_on_neighbors(_apply, post=_post)
     )
     assert_lines(out.lines(), golden)
+
+
+# ---------------------------------------------------------------------------
+# Randomized differential: sharded vs single-shard slice aggregations must
+# agree on arbitrary streams, not just the 7-edge fixture (the goldens pin
+# exactness; this pins breadth).
+
+import numpy as np
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize(
+    "direction", [EdgeDirection.OUT, EdgeDirection.IN, EdgeDirection.ALL]
+)
+def test_slice_sharded_matches_single_random(seed, direction):
+    rng = np.random.default_rng(seed)
+    cap, deg, n = 32, 32, int(rng.integers(20, 120))
+    edges = [
+        (int(a), int(b), int(a) * 100 + int(b))
+        for a, b in zip(
+            rng.integers(0, cap, n), rng.integers(0, cap, n)
+        )
+    ]
+    single = StreamConfig(vertex_capacity=cap, max_degree=deg, batch_size=8)
+    sharded = StreamConfig(
+        vertex_capacity=cap, max_degree=deg, batch_size=8, num_shards=8
+    )
+
+    def run(cfg):
+        out = (
+            EdgeStream.from_collection(edges, cfg, batch_size=8)
+            .slice(1000, direction)
+            .reduce_on_edges(_reduce)
+        )
+        return sorted(out.lines())
+
+    assert run(sharded) == run(single), f"seed={seed} dir={direction}"
