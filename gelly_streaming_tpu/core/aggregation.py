@@ -78,6 +78,25 @@ class SummaryAggregation:
     def transform(self, state):
         return state
 
+    def mesh_combine_states(self, cfg: StreamConfig, axis_name: str):
+        """Optional COLLECTIVE cross-shard combine for the mesh data plane.
+
+        Return a function ``(state, has_data) -> state`` that runs INSIDE
+        shard_map over the mesh axis and reduces every shard's partial into
+        the same (replicated-identical) combined state using XLA collectives
+        (pmin/pmax/psum/ppermute riding ICI), or None to use the generic
+        all_gather + sequential-combine fold.  ``has_data`` is this shard's
+        "my bucket was non-empty" flag; descriptors whose initial state is a
+        combine identity may ignore it.
+
+        This is the TPU-native replacement for the reference's all-to-one
+        ``timeWindowAll.reduce`` (SummaryBulkAggregation.java:81-83): instead
+        of funneling S partials to one task and merging S-1 times
+        sequentially, the combine is a logarithmic-depth collective over the
+        mesh — the asymptotic win the sharded plane exists for.
+        """
+        return None
+
     # -- execution ------------------------------------------------------------
 
     def _num_partitions(self, cfg: StreamConfig) -> int:
@@ -757,11 +776,20 @@ class MeshAggregationRunner:
     def num_shards(self) -> int:
         return self.mesh.devices.size
 
-    def _shard_fold_combine(self, cfg: StreamConfig):
-        """The shared in-shard_map tail: fold this shard's bucket with
-        updateFun, all_gather the partials over the mesh axis (riding ICI),
-        and run the descriptor's combine strategy, masking empty shards."""
+    def _combine_over_mesh(self, cfg: StreamConfig):
+        """``(state, has_data) -> state``: reduce every shard's partial into
+        the same replicated combined state, inside shard_map.
+
+        Uses the descriptor's collective combine (``mesh_combine_states`` —
+        log-depth XLA collectives over ICI) when it supplies one, else
+        all_gather + the descriptor's combine strategy with empty shards
+        masked out (descriptors whose initial state is not a combine
+        identity must not see initial_state partials — the simulated runtime
+        skips empty partitions the same way)."""
         agg, axis, n = self.agg, self._axis, self.num_shards
+        collective = agg.mesh_combine_states(cfg, axis)
+        if collective is not None:
+            return collective
 
         def masked_combine(a, b):
             """Combine (state, valid) pairs, ignoring empty-shard partials."""
@@ -777,21 +805,32 @@ class MeshAggregationRunner:
             )
             return state, va | vb
 
-        def fold_combine(src, dst, val, mask):
-            state = agg.initial_state(cfg)
-            state = agg.update(state, src, dst, val, mask)
+        def gather_combine(state, has_data):
             gathered = jax.tree.map(
                 lambda a: jax.lax.all_gather(a, axis), state
             )
-            has_data = jax.lax.all_gather(jnp.any(mask), axis)
+            has = jax.lax.all_gather(has_data, axis)
             parts = [
-                (jax.tree.map(lambda g: g[i], gathered), has_data[i])
+                (jax.tree.map(lambda g: g[i], gathered), has[i])
                 for i in range(n)
             ]
             acc, _ = agg._fold_partials(
                 parts, masked_combine, agg._tree_fanin(cfg)
             )
             return acc
+
+        return gather_combine
+
+    def _shard_fold_combine(self, cfg: StreamConfig):
+        """The shared in-shard_map tail: fold this shard's bucket with
+        updateFun, then reduce the partials over the mesh axis."""
+        agg = self.agg
+        combine = self._combine_over_mesh(cfg)
+
+        def fold_combine(src, dst, val, mask):
+            state = agg.initial_state(cfg)
+            state = agg.update(state, src, dst, val, mask)
+            return combine(state, jnp.any(mask))
 
         return fold_combine
 

@@ -58,3 +58,21 @@ class BipartitenessCheck(SummaryBulkAggregation):
 
     def transform(self, state: BPState) -> Candidates:
         return Candidates(state.parent2, state.seen)
+
+    def mesh_combine_states(self, cfg: StreamConfig, axis_name: str):
+        """Collective cross-shard combine on the doubled space: the same
+        pmin-round fixpoint as CC (each shard's parent2 pointers are its
+        local parity constraints) — the TPU-native form of Candidates'
+        partition merge (BipartitenessCheck.java:128-130)."""
+        from gelly_streaming_tpu.library.connected_components import (
+            collective_parent_seen_combine,
+        )
+
+        def combine(state: BPState, has_data) -> BPState:
+            return BPState(
+                *collective_parent_seen_combine(
+                    state.parent2, state.seen, axis_name
+                )
+            )
+
+        return combine
