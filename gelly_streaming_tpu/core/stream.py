@@ -216,6 +216,16 @@ class EdgeStream:
 
         EF40 buffers carry a sorted multiset, so non-order-free
         aggregations refuse them (same rule as ``wire_encoding='ef40'``).
+
+        Vertex-id bounds: ids must be interned (< cfg.vertex_capacity) —
+        out-of-range ids would silently clamp/drop in device scatters (the
+        corruption mode ``from_arrays`` guards with a loud ValueError).  An
+        EF40 width whose capacity exceeds cfg.vertex_capacity is refused
+        outright (it fully bounds decoded ids); fixed-width buffers whose
+        encoding can express ids >= vertex_capacity get the FIRST buffer
+        decoded and checked as a smoke guard — full validation of every
+        buffer is the producer's contract (decoding the whole stream here
+        would defeat the replay fast path).  Tail ids are always checked.
         """
         bufs = list(bufs)
         from ..io import wire as _wire
@@ -224,6 +234,14 @@ class EdgeStream:
             isinstance(width, tuple) and len(width) == 2 and width[0] == _wire.EF40
         ):
             raise ValueError(f"unsupported wire width {width}")
+        cap = cfg.vertex_capacity
+        if isinstance(width, tuple) and width[1] > cap:
+            raise ValueError(
+                f"EF40 width capacity {width[1]} exceeds "
+                f"cfg.vertex_capacity {cap}: decoded ids could reach "
+                f"{width[1] - 1} and silently corrupt device state; "
+                "intern ids first (io.interning.VertexInterner)"
+            )
         expect = _wire.wire_nbytes(batch_size, width)
         for i, b in enumerate(bufs):
             b = np.asarray(b)
@@ -236,11 +254,34 @@ class EdgeStream:
                     f"wire buffer {i} holds {b.nbytes} bytes; "
                     f"batch_size={batch_size} at width {width} needs {expect}"
                 )
+        if not isinstance(width, tuple):
+            # fixed-width encodings can express ids beyond vertex_capacity;
+            # decode the FIRST buffer as a smoke guard (full validation is
+            # the producer's contract — see docstring)
+            id_bound = (1 << 20) if width == _wire.PAIR40 else (1 << (8 * width))
+            if id_bound > cap and bufs:
+                s0, d0 = _wire.unpack_edges_host(
+                    np.asarray(bufs[0]), batch_size, width
+                )
+                if len(s0) and int(max(s0.max(), d0.max())) >= cap:
+                    raise ValueError(
+                        f"wire buffer 0 decodes vertex ids >= "
+                        f"vertex_capacity {cap}; intern ids first "
+                        "(io.interning.VertexInterner)"
+                    )
         if tail is not None:
             t_src = np.ascontiguousarray(tail[0], dtype=np.int32)
             t_dst = np.ascontiguousarray(tail[1], dtype=np.int32)
             if t_src.shape != t_dst.shape or len(t_src) >= batch_size:
                 raise ValueError("tail must be a (src, dst) pair shorter than one batch")
+            if len(t_src) and (
+                min(t_src.min(), t_dst.min()) < 0
+                or max(t_src.max(), t_dst.max()) >= cap
+            ):
+                raise ValueError(
+                    f"tail vertex ids must be in [0, vertex_capacity={cap}); "
+                    "intern ids first (io.interning.VertexInterner)"
+                )
             # an empty tail is no tail: the fast path would otherwise compile
             # and run a fully masked-out padded tail step
             tail = (t_src, t_dst) if len(t_src) else None

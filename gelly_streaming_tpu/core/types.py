@@ -86,8 +86,29 @@ class EdgeBatch:
             # in the cast — fail loudly instead (same philosophy as the
             # vertex-id bounds check in EdgeStream.from_arrays): rebase to
             # stream-relative ms first.
-            if not isinstance(time, jax.core.Tracer):  # host arrays only:
-                # traced construction (e.g. inside a jitted step) stays legal
+            # Traced construction (inside a jitted step) stays legal.  A
+            # concrete device jax.Array is judged by DTYPE alone — no
+            # np.asarray, which would force a device->host sync per batch
+            # (~40-65 ms through the session tunnel) on timed hot paths: a
+            # signed integer dtype of <= 32 bits cannot wrap in the int32
+            # cast, anything wider (or float/uint32+) could hold
+            # epoch-scale values and is refused without materializing.
+            # Host inputs (lists, numpy) keep the exact value check.
+            if isinstance(time, jax.core.Tracer):
+                pass
+            elif isinstance(time, jax.Array):
+                dt = np.dtype(time.dtype)
+                safe = (dt.kind == "i" and dt.itemsize <= 4) or (
+                    dt.kind == "u" and dt.itemsize <= 2
+                )
+                if not safe:
+                    raise ValueError(
+                        f"device timestamp arrays must use a signed integer "
+                        f"dtype of <= 32 bits (got {dt}): wider or "
+                        "non-integer values could wrap in the int32 cast; "
+                        "rebase to stream-relative ms on host first"
+                    )
+            else:
                 t_host = np.asarray(time)
                 if t_host.size and (
                     t_host.max() > np.iinfo(np.int32).max

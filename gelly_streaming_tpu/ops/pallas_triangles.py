@@ -154,8 +154,22 @@ def pack_pane(u: np.ndarray, v: np.ndarray, mask=None):
     if mask is not None:
         u, v = np.asarray(u)[mask], np.asarray(v)[mask]
     n = len(u)
-    cap = max(1, 1 << (n - 1).bit_length()) if n else 1
-    w = np.zeros((cap,), np.uint32)
+    if n:
+        u = np.asarray(u)
+        v = np.asarray(v)
+        # u packs into the low _ID_BITS; a larger id would silently bleed
+        # into v's bits (corrupted edges, no error) — current callers bound
+        # ids by the dense-pane cap, but guard future callers loudly
+        if int(min(u.min(), v.min())) < 0 or int(max(u.max(), v.max())) >= (
+            1 << _ID_BITS
+        ):
+            raise ValueError(
+                f"pack_pane ids must be in [0, 2^{_ID_BITS}); got "
+                f"[{int(min(u.min(), v.min()))}, "
+                f"{int(max(u.max(), v.max()))}]"
+            )
+    n_cap = max(1, 1 << (n - 1).bit_length()) if n else 1
+    w = np.zeros((n_cap,), np.uint32)
     w[:n] = u.astype(np.uint32) | (v.astype(np.uint32) << _ID_BITS)
     return w, np.int32(n)
 

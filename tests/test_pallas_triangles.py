@@ -58,3 +58,21 @@ def test_rejects_unpadded_shapes():
         pallas_triangles.triangle_count_dense(
             jnp.zeros((100, 100), jnp.bfloat16), interpret=True
         )
+
+
+def test_pack_pane_rejects_oversized_ids():
+    """pack_pane packs u into the low id bits — an id >= 2^_ID_BITS would
+    silently bleed into v (advisor r3 low); it must raise instead."""
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu.ops.pallas_triangles import _ID_BITS, pack_pane
+
+    ok_u = np.array([1, 2], np.int32)
+    ok_v = np.array([3, (1 << _ID_BITS) - 1], np.int32)
+    w, n = pack_pane(ok_u, ok_v)
+    assert int(n) == 2
+    with pytest.raises(ValueError, match="pack_pane ids"):
+        pack_pane(np.array([1 << _ID_BITS], np.int32), np.array([0], np.int32))
+    with pytest.raises(ValueError, match="pack_pane ids"):
+        pack_pane(np.array([-1], np.int32), np.array([0], np.int32))

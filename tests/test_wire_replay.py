@@ -252,3 +252,37 @@ def test_replay_feeds_block_sharded_cc():
     outs = list(BlockShardedCC().run(stream))
     labels = unshard_labels(outs[-1][0])
     assert np.array_equal(labels, host_min_labels(capacity, src, dst))
+
+
+def test_from_wire_bounds_checks_ids():
+    """Out-of-range vertex ids must fail loudly at construction (advisor r3
+    medium): EF40 widths wider than the config are refused outright; fixed
+    widths get the first buffer decoded as a smoke guard; tail ids are
+    always checked."""
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.io import wire
+
+    cfg = StreamConfig(vertex_capacity=64, batch_size=8)
+    # EF40 capacity beyond cfg.vertex_capacity: refused without decoding
+    with pytest.raises(ValueError, match="EF40 width capacity"):
+        EdgeStream.from_wire([], 8, (wire.EF40, 1 << 20), cfg)
+    # fixed width whose id range exceeds capacity: first buffer smoke-checked
+    bad = wire.pack_edges(
+        np.array([70] * 8, np.int32), np.array([1] * 8, np.int32), 2
+    )
+    with pytest.raises(ValueError, match="decodes vertex ids"):
+        EdgeStream.from_wire([bad], 8, 2, cfg)
+    ok = wire.pack_edges(
+        np.array([63] * 8, np.int32), np.array([1] * 8, np.int32), 2
+    )
+    EdgeStream.from_wire([ok], 8, 2, cfg)  # in-range ids pass
+    # tail ids always checked (raw arrays, cheap)
+    with pytest.raises(ValueError, match="tail vertex ids"):
+        EdgeStream.from_wire(
+            [ok], 8, 2, cfg,
+            tail=(np.array([99], np.int32), np.array([1], np.int32)),
+        )
