@@ -26,7 +26,7 @@ import numpy as np
 
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
-from gelly_streaming_tpu.core.windows import WindowPane, assign_tumbling_windows
+from gelly_streaming_tpu.core.windows import WindowPane, stream_panes
 
 
 @jax.jit
@@ -158,13 +158,21 @@ class SummaryAggregation:
             )
             and cfg.num_shards > 1
             and cfg.num_shards <= len(jax.devices())
+            # ingestion-time panes demand per-window emission; the streaming
+            # fold emits once at stream end, so route to the windowed paths
+            and not (cfg.ingest_window_edges or cfg.ingest_window_ms)
         )
 
     def _wire_eligible(self, stream) -> bool:
+        cfg = stream.cfg
         return (
-            getattr(stream, "_wire_arrays", None) is not None
-            or getattr(stream, "_wire_packed", None) is not None
-        ) and self._num_partitions(stream.cfg) == 1
+            (
+                getattr(stream, "_wire_arrays", None) is not None
+                or getattr(stream, "_wire_packed", None) is not None
+            )
+            and self._num_partitions(cfg) == 1
+            and not (cfg.ingest_window_edges or cfg.ingest_window_ms)
+        )
 
     def _wire_fused_step(self, stream, batch: int, width):
         """Jitted (stage-states, summary), wire-buffer -> carry step, cached so
@@ -561,6 +569,13 @@ class SummaryAggregation:
         runs the real sharded data plane (MeshAggregationRunner); otherwise
         partitions are simulated sequentially (the MiniCluster shape).  All
         paths share the Merger/checkpoint loop (`_merge_loop`)."""
+        if checkpoint_path and stream.cfg.ingest_window_ms:
+            raise ValueError(
+                "wall-clock ingestion panes (ingest_window_ms) are not "
+                "replay-deterministic: a resume would skip panes by id that "
+                "cover different edges than the crashed run's; use "
+                "ingest_window_edges for checkpointed runs"
+            )
         packed = getattr(stream, "_wire_packed", None)
         if packed is not None and isinstance(packed[2], tuple) and not self.order_free:
             # EF40 replay buffers carry per-batch sorted multisets; EVERY
@@ -626,7 +641,7 @@ class SummaryAggregation:
         def records() -> Iterator[tuple]:
             return self._merge_loop(
                 cfg,
-                assign_tumbling_windows(stream.batches(), window_ms),
+                stream_panes(stream, window_ms),
                 fold_pane,
                 checkpoint_path,
                 restore,
@@ -1391,9 +1406,7 @@ class MeshAggregationRunner:
             # each shard's bytes transfer straight to their owner device
             sharding = NamedSharding(self.mesh, P(self._axis))
             pane_iter = (
-                panes()
-                if panes is not None
-                else assign_tumbling_windows(stream.batches(), window_ms)
+                panes() if panes is not None else stream_panes(stream, window_ms)
             )
             with wire_mod.Prefetcher(
                 pane_iter, prepare, device=sharding, depth=cfg.prefetch_depth

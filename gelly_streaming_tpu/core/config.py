@@ -52,10 +52,28 @@ class StreamConfig:
     # snapshot only at stream end); each snapshot downloads the fold carry,
     # so the interval trades recovery granularity against ingest rate
     wire_checkpoint_batches: int = 64
+    # Ingestion-time pane cut (the reference's DEFAULT mode: wall-clock
+    # tumbling windows with running emission, SimpleEdgeStream.java:69-73).
+    # Without either knob an untimed stream forms one global pane flushed at
+    # end-of-stream — correct for finite tests, but an infinite untimed
+    # source would never emit.  Set ingest_window_edges (deterministic:
+    # close a pane every N arrivals) or ingest_window_ms (wall-clock; panes
+    # cut at batch boundaries) to get per-window running summaries.  When
+    # set, aggregation panes are cut by ARRIVAL — any event timestamps the
+    # stream carries are ignored (pick one time characteristic per
+    # pipeline, as the reference's two ctors do).
+    ingest_window_edges: int = 0
+    ingest_window_ms: int = 0
 
     def __post_init__(self):
         if self.wire_encoding not in ("auto", "plain", "ef40"):
             raise ValueError(f"unknown wire_encoding {self.wire_encoding!r}")
+        if self.ingest_window_edges < 0 or self.ingest_window_ms < 0:
+            raise ValueError("ingest window knobs must be >= 0")
+        if self.ingest_window_edges and self.ingest_window_ms:
+            raise ValueError(
+                "set only one of ingest_window_edges / ingest_window_ms"
+            )
         if self.wire_checkpoint_batches < 0:
             raise ValueError("wire_checkpoint_batches must be >= 0")
         if self.vertex_capacity <= 0:

@@ -270,18 +270,22 @@ class EdgeStream:
                         "(io.interning.VertexInterner)"
                     )
         if tail is not None:
-            t_src = np.ascontiguousarray(tail[0], dtype=np.int32)
-            t_dst = np.ascontiguousarray(tail[1], dtype=np.int32)
-            if t_src.shape != t_dst.shape or len(t_src) >= batch_size:
-                raise ValueError("tail must be a (src, dst) pair shorter than one batch")
-            if len(t_src) and (
-                min(t_src.min(), t_dst.min()) < 0
-                or max(t_src.max(), t_dst.max()) >= cap
+            t_src0 = np.asarray(tail[0])
+            t_dst0 = np.asarray(tail[1])
+            # bounds BEFORE the int32 cast: a cast-first check would let
+            # 64-bit ids wrap into range (same rule as from_arrays)
+            if len(t_src0) and (
+                min(t_src0.min(), t_dst0.min()) < 0
+                or max(t_src0.max(), t_dst0.max()) >= cap
             ):
                 raise ValueError(
                     f"tail vertex ids must be in [0, vertex_capacity={cap}); "
                     "intern ids first (io.interning.VertexInterner)"
                 )
+            t_src = np.ascontiguousarray(t_src0, dtype=np.int32)
+            t_dst = np.ascontiguousarray(t_dst0, dtype=np.int32)
+            if t_src.shape != t_dst.shape or len(t_src) >= batch_size:
+                raise ValueError("tail must be a (src, dst) pair shorter than one batch")
             # an empty tail is no tail: the fast path would otherwise compile
             # and run a fully masked-out padded tail step
             tail = (t_src, t_dst) if len(t_src) else None

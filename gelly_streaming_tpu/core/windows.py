@@ -158,3 +158,77 @@ def assign_tumbling_windows(
 
     for wid in panes.open_ids():
         yield panes.close(wid)
+
+
+def assign_ingestion_windows(
+    batches: Iterator[EdgeBatch],
+    every_edges: int = 0,
+    every_ms: int = 0,
+    clock=None,
+) -> Iterator[WindowPane]:
+    """Tumbling panes for UNTIMED streams: the reference's default
+    ingestion-time mode (SimpleEdgeStream.java:69-73; running emission per
+    window, ConnectedComponentsExample.java:65-67).
+
+    ``every_edges`` cuts a pane every N arrivals — deterministic, the right
+    choice for tests and replayable streams.  ``every_ms`` cuts by
+    wall-clock at BATCH boundaries (the host assigns each batch to the pane
+    open at its arrival instant; a pane closes when a later batch arrives
+    past its end — the un-timered approximation of Flink's processing-time
+    triggers).  Any timestamps the batches carry are ignored: callers route
+    timed streams to ``assign_tumbling_windows`` (event time precedes
+    ingestion time, as in the reference's two ctors).
+
+    Panes carry synthetic ascending window ids (0, 1, ...) and
+    ``max_timestamp=-1`` (no event-time meaning), so the Merger's running
+    merge works unchanged.  Positional checkpoints are sound only for
+    ``every_edges`` (a replayed stream cuts the same panes); wall-clock
+    panes are NOT replay-deterministic — a resume could skip edges the
+    crashed run never folded — so checkpointed runs refuse ``every_ms``
+    (enforced in SummaryAggregation.run / BlockShardedCC.run).
+    """
+    import time as _time
+
+    if bool(every_edges) == bool(every_ms):
+        raise ValueError("set exactly one of every_edges / every_ms")
+    clock = clock or _time.monotonic
+    panes = PaneAssembler(0)  # window_ms=0 -> max_timestamp=-1 on close
+    count = 0
+    t0 = None
+
+    for batch in batches:
+        src, dst, val, _time_ignored = _batch_to_host(batch)
+        if len(src) == 0:
+            continue
+        if every_edges:
+            wids = (count + np.arange(len(src), dtype=np.int64)) // every_edges
+            count += len(src)
+        else:
+            now = clock()
+            if t0 is None:
+                t0 = now
+            wid = int((now - t0) * 1000.0 // every_ms)
+            wids = np.full((len(src),), wid, np.int64)
+        panes.add(src, dst, val, None, wids)
+        newest = int(wids.max())
+        for wid in [w for w in panes.open_ids() if 0 <= w < newest]:
+            yield panes.close(wid)
+
+    for wid in panes.open_ids():
+        yield panes.close(wid)
+
+
+def stream_panes(stream, window_ms: int) -> Iterator[WindowPane]:
+    """The pane source for an aggregation over ``stream``: ingestion-time
+    panes when the config asks for them, else event-time tumbling windows
+    (untimed streams degrade to the single global pane there).  Shared by
+    the simulated runtime, the mesh runner, and BlockShardedCC so the time
+    plane cannot diverge between execution paths."""
+    cfg = stream.cfg
+    if cfg.ingest_window_edges or cfg.ingest_window_ms:
+        return assign_ingestion_windows(
+            stream.batches(),
+            cfg.ingest_window_edges,
+            cfg.ingest_window_ms,
+        )
+    return assign_tumbling_windows(stream.batches(), window_ms)

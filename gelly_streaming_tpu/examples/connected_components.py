@@ -1,30 +1,72 @@
 """Streaming Connected Components example
 (reference: example/ConnectedComponentsExample.java:40-168).
 
-Usage: connected_components [input-path [output-path [window-ms [--tree]]]]
+Usage: connected_components [input-path [output-path [window-ms [--tree]
+                            [--unbounded[=BATCHES]] [--ingest-window=EDGES]]]]
 Emits the running component sets (flattened DisjointSet) per merge window.
+
+``--unbounded`` replaces the input with an endless untimed generated stream
+— the reference's default ingestion-time mode
+(ConnectedComponentsExample.java:65-67 prints per wall-clock window) — and
+``--ingest-window=EDGES`` cuts a pane every EDGES arrivals so running
+components print continuously (default 4096).  ``--unbounded=BATCHES``
+bounds the stream for demos/tests; bare ``--unbounded`` runs until killed,
+exactly like the reference under an unbounded source.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional
 
 from gelly_streaming_tpu.core.output import OutputStream
-from gelly_streaming_tpu.examples._cli import emit, input_stream, parse_argv
+from gelly_streaming_tpu.examples._cli import (
+    DEFAULT_CFG,
+    emit,
+    input_stream,
+    parse_argv,
+)
 from gelly_streaming_tpu.library.connected_components import (
     ConnectedComponents,
     ConnectedComponentsTree,
 )
 
-USAGE = "connected_components [input-path [output-path [window-ms [--tree]]]]"
+USAGE = (
+    "connected_components [input-path [output-path [window-ms [--tree] "
+    "[--unbounded[=BATCHES]] [--ingest-window=EDGES]]]]"
+)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
-    args = parse_argv(argv, USAGE, 4)
+    args = parse_argv(argv, USAGE, 6)
     use_tree = "--tree" in args
-    args = [a for a in args if a != "--tree"]
+    unbounded = next((a for a in args if a.startswith("--unbounded")), None)
+    ingest = next((a for a in args if a.startswith("--ingest-window")), None)
+    args = [a for a in args if not a.startswith("--")]
     window_ms = int(args[2]) if len(args) > 2 else 1000
-    stream, output = input_stream(args)
+    every = int(ingest.split("=", 1)[1]) if ingest and "=" in ingest else None
+    if unbounded is not None:
+        from gelly_streaming_tpu.io.sources import unbounded_generated_stream
+
+        max_batches = (
+            int(unbounded.split("=", 1)[1]) if "=" in unbounded else None
+        )
+        cfg = dataclasses.replace(
+            DEFAULT_CFG, ingest_window_edges=every or 4096
+        )
+        stream = unbounded_generated_stream(
+            cfg, num_vertices=100, max_batches=max_batches
+        )
+        output = args[1] if len(args) > 1 else None
+    else:
+        # --ingest-window applies to file/generated input too: running
+        # emission every N arrivals instead of one end-of-stream summary
+        cfg = (
+            dataclasses.replace(DEFAULT_CFG, ingest_window_edges=every)
+            if every
+            else DEFAULT_CFG
+        )
+        stream, output = input_stream(args, cfg)
     algo = (ConnectedComponentsTree if use_tree else ConnectedComponents)(window_ms)
     results = stream.aggregate(algo)
     # Flatten each window's summary into component rows (FlattenSet analog,

@@ -169,3 +169,34 @@ def generated_stream(
     src = rng.integers(0, n_v, num_edges).astype(np.int32)
     dst = rng.integers(0, n_v, num_edges).astype(np.int32)
     return EdgeStream.from_arrays(src, dst, cfg, batch_size=batch_size)
+
+
+def unbounded_generated_stream(
+    cfg: StreamConfig,
+    num_vertices: Optional[int] = None,
+    seed: int = 0,
+    max_batches: Optional[int] = None,
+) -> EdgeStream:
+    """UNBOUNDED uniform random edge stream (untimed).
+
+    The reference's default mode is an endless ingestion-time stream with
+    running per-window emission (SimpleEdgeStream.java:69-73); pair this
+    source with ``cfg.ingest_window_edges`` (or ``ingest_window_ms``) so
+    aggregations emit running summaries instead of waiting for an
+    end-of-stream that never comes.  ``max_batches`` bounds the stream for
+    tests/demos; None streams forever.
+    """
+    from gelly_streaming_tpu.core.types import EdgeBatch
+
+    n_v = num_vertices or cfg.vertex_capacity
+
+    def factory():
+        rng = np.random.default_rng(seed)
+        k = 0
+        while max_batches is None or k < max_batches:
+            src = rng.integers(0, n_v, cfg.batch_size).astype(np.int32)
+            dst = rng.integers(0, n_v, cfg.batch_size).astype(np.int32)
+            yield EdgeBatch.from_arrays(src, dst)
+            k += 1
+
+    return EdgeStream.from_batches(factory, cfg)

@@ -319,9 +319,15 @@ class BlockShardedCC:
         a multi-process mesh has non-addressable shards and needs a
         per-process (orbax-style) save, which this runner does not implement.
         """
-        from gelly_streaming_tpu.core.windows import assign_tumbling_windows
+        from gelly_streaming_tpu.core.windows import stream_panes
 
         cfg = stream.cfg
+        if checkpoint_path and cfg.ingest_window_ms:
+            raise ValueError(
+                "wall-clock ingestion panes (ingest_window_ms) are not "
+                "replay-deterministic; use ingest_window_edges for "
+                "checkpointed runs"
+            )
         n = self.num_shards
         window_ms = self.window_ms or cfg.window_ms
 
@@ -369,9 +375,7 @@ class BlockShardedCC:
                 sharding,
             )
             pane_iter = (
-                panes()
-                if panes is not None
-                else assign_tumbling_windows(stream.batches(), window_ms)
+                panes() if panes is not None else stream_panes(stream, window_ms)
             )
             for pane in pane_iter:
                 already = (0 <= pane.window_id <= start_after) or (

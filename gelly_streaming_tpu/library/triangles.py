@@ -29,7 +29,7 @@ import numpy as np
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.core.types import EdgeDirection
-from gelly_streaming_tpu.core.windows import assign_tumbling_windows
+from gelly_streaming_tpu.core.windows import stream_panes
 from gelly_streaming_tpu.ops import neighbors as nbr_ops
 from gelly_streaming_tpu.ops import pallas_triangles
 
@@ -252,7 +252,7 @@ def window_triangles(stream, window_ms: int) -> OutputStream:
 
     def records() -> Iterator[tuple]:
         pending = None  # (handle, timestamp) of the previous pane
-        for pane in assign_tumbling_windows(stream.batches(), window_ms):
+        for pane in stream_panes(stream, window_ms):
             try:
                 handle = _pane_triangle_submit(pane.src, pane.dst)
             except BaseException:

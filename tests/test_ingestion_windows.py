@@ -1,0 +1,232 @@
+"""Ingestion-time pane cutting for untimed streams (VERDICT r3 missing #2).
+
+The reference's DEFAULT mode is ingestion-time tumbling windows with running
+emission (SimpleEdgeStream.java:69-73; ConnectedComponentsExample.java:65-67
+prints per window).  Without the knobs an untimed stream is one global pane
+flushed at end-of-stream — an infinite source would never emit.  These tests
+pin the arrival-count cut (deterministic), the wall-clock cut (injected
+clock), running emission over an unbounded generator, checkpoint/resume on
+synthetic window ids, and that finite-stream goldens are unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.core.types import EdgeBatch
+from gelly_streaming_tpu.core.windows import assign_ingestion_windows
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+
+
+def _batches(chunks):
+    def factory():
+        for s, d in chunks:
+            yield EdgeBatch.from_arrays(
+                np.asarray(s, np.int32), np.asarray(d, np.int32)
+            )
+
+    return factory
+
+
+def test_arrival_count_panes_split_mid_batch():
+    chunks = [([1, 2, 3], [2, 3, 4]), ([5, 6], [6, 7])]
+    panes = list(
+        assign_ingestion_windows(_batches(chunks)(), every_edges=2)
+    )
+    # 5 edges at 2/pane -> panes of 2, 2, 1 with ascending ids
+    assert [p.window_id for p in panes] == [0, 1, 2]
+    assert [p.num_edges for p in panes] == [2, 2, 1]
+    assert list(panes[0].src) == [1, 2] and list(panes[1].src) == [3, 5]
+    assert all(p.max_timestamp == -1 for p in panes)
+
+
+def test_wall_clock_panes_cut_at_batch_boundaries():
+    now = [0.0]
+    chunks = [([1], [2]), ([3], [4]), ([5], [6])]
+
+    def clock():
+        now[0] += 0.6  # 600 ms between batch arrivals
+        return now[0]
+
+    panes = list(
+        assign_ingestion_windows(
+            _batches(chunks)(), every_ms=1000, clock=clock
+        )
+    )
+    # arrivals at 0, 600, 1200 ms relative to the first -> windows 0, 0, 1
+    assert [p.window_id for p in panes] == [0, 1]
+    assert [p.num_edges for p in panes] == [2, 1]
+
+
+def test_unbounded_generator_emits_running_components():
+    """An infinite untimed source yields one running summary per pane —
+    WITHOUT reaching end-of-stream (the generator is never exhausted)."""
+    from gelly_streaming_tpu.io.sources import unbounded_generated_stream
+
+    cfg = StreamConfig(
+        vertex_capacity=64, batch_size=8, ingest_window_edges=16
+    )
+    stream = unbounded_generated_stream(cfg, num_vertices=32, max_batches=None)
+    out = iter(stream.aggregate(ConnectedComponents()))
+    first = next(out)[0]
+    second = next(out)[0]
+    third = next(out)[0]
+    # running merge: component count is non-increasing as edges accumulate
+    n1 = len(first.components())
+    n3 = len(third.components())
+    assert n3 <= n1
+    out.close()
+
+
+def test_ingest_panes_match_global_pane_final_summary():
+    """Finite stream: the LAST running summary equals the single-global-pane
+    result (same edges, same order-free fold) and finite goldens without the
+    knob are unchanged."""
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 64, 200).astype(np.int32)
+    dst = rng.integers(0, 64, 200).astype(np.int32)
+    plain_cfg = StreamConfig(vertex_capacity=64, batch_size=32)
+    ingest_cfg = StreamConfig(
+        vertex_capacity=64, batch_size=32, ingest_window_edges=48
+    )
+    plain = (
+        EdgeStream.from_arrays(src, dst, plain_cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert len(plain) == 1  # single global pane -> one emission
+    windowed = (
+        EdgeStream.from_arrays(src, dst, ingest_cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert len(windowed) == -(-200 // 48)  # one emission per pane
+    assert windowed[-1][0].components() == plain[-1][0].components()
+
+
+def test_ingest_panes_checkpoint_resume(tmp_path):
+    import os
+
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 64, 160).astype(np.int32)
+    dst = rng.integers(0, 64, 160).astype(np.int32)
+    cfg = StreamConfig(
+        vertex_capacity=64, batch_size=32, ingest_window_edges=40
+    )
+    ckpt = os.path.join(str(tmp_path), "ingest_cc.npz")
+    stream = lambda: EdgeStream.from_arrays(src, dst, cfg)  # noqa: E731
+    full = [
+        str(r[0])
+        for r in stream().aggregate(ConnectedComponents()).collect()
+    ]
+    it = iter(stream().aggregate(ConnectedComponents(), checkpoint_path=ckpt))
+    next(it)
+    next(it)
+    it.close()
+    resumed = [
+        str(r[0])
+        for r in stream()
+        .aggregate(ConnectedComponents(), checkpoint_path=ckpt)
+        .collect()
+    ]
+    # window 0 snapshot landed; window 1's emission re-emits (at-least-once)
+    assert resumed == full[1:]
+
+
+def test_ingest_knobs_validated():
+    with pytest.raises(ValueError, match="only one"):
+        StreamConfig(ingest_window_edges=4, ingest_window_ms=100)
+    with pytest.raises(ValueError, match=">= 0"):
+        StreamConfig(ingest_window_edges=-1)
+    with pytest.raises(ValueError, match="exactly one"):
+        list(assign_ingestion_windows(iter([]), 0, 0))
+
+
+def test_unbounded_cc_example_prints_per_window(capsys):
+    from gelly_streaming_tpu.examples.connected_components import main
+
+    main(["--unbounded=4", "--ingest-window=1024"])
+    lines = [
+        line
+        for line in capsys.readouterr().out.splitlines()
+        if line and line[0].isdigit()
+    ]
+    # 4 batches x 4096 edges at 1024/pane = 16 panes, each printing >= 1
+    # component row (vs exactly one print for the whole stream without the
+    # ingest knob — the running-emission UX of the reference's example)
+    assert len(lines) >= 16
+
+
+def test_mesh_runner_ingest_panes_match_simulated():
+    """Ingestion-time panes flow through the sharded runner identically."""
+    rng = np.random.default_rng(7)
+    src = rng.integers(0, 64, 128).astype(np.int32)
+    dst = rng.integers(0, 64, 128).astype(np.int32)
+    single = StreamConfig(vertex_capacity=64, batch_size=16, ingest_window_edges=24)
+    sharded = StreamConfig(
+        vertex_capacity=64, batch_size=16, num_shards=8, ingest_window_edges=24
+    )
+    expect = [
+        str(r[0])
+        for r in EdgeStream.from_arrays(src, dst, single)
+        .aggregate(ConnectedComponents())
+        .collect()
+    ]
+    got = [
+        str(r[0])
+        for r in EdgeStream.from_arrays(src, dst, sharded)
+        .aggregate(ConnectedComponents())
+        .collect()
+    ]
+    assert got == expect
+
+
+def test_wall_clock_panes_refuse_checkpointing(tmp_path):
+    import os
+
+    cfg = StreamConfig(vertex_capacity=64, batch_size=8, ingest_window_ms=100)
+    stream = EdgeStream.from_collection([(1, 2, 0.0)], cfg, batch_size=2)
+    with pytest.raises(ValueError, match="not\\s+replay-deterministic"):
+        stream.aggregate(
+            ConnectedComponents(),
+            checkpoint_path=os.path.join(str(tmp_path), "x.npz"),
+        ).collect()
+
+
+def test_from_wire_tail_rejects_wrapping_ids():
+    """Tail bounds must be checked BEFORE the int32 cast (review finding:
+    a 64-bit id that wraps into range must not pass)."""
+    from gelly_streaming_tpu.io import wire
+
+    cfg = StreamConfig(vertex_capacity=64, batch_size=8)
+    ok = wire.pack_edges(
+        np.array([1] * 8, np.int32), np.array([2] * 8, np.int32), 2
+    )
+    with pytest.raises(ValueError, match="tail vertex ids"):
+        EdgeStream.from_wire(
+            [ok], 8, 2, cfg,
+            tail=(
+                np.array([(1 << 32) + 5], np.int64),
+                np.array([1], np.int64),
+            ),
+        )
+
+
+def test_cc_example_ingest_window_applies_to_generated_input(capsys):
+    from gelly_streaming_tpu.examples.connected_components import main
+
+    main(["--ingest-window=200"])
+    rows = [
+        line
+        for line in capsys.readouterr().out.splitlines()
+        if line and line[0].isdigit()
+    ]
+    main([])
+    rows_plain = [
+        line
+        for line in capsys.readouterr().out.splitlines()
+        if line and line[0].isdigit()
+    ]
+    # 1000 generated edges at 200/pane -> 5 running emissions vs 1
+    assert len(rows) > len(rows_plain)
