@@ -61,23 +61,87 @@ class _Stateless(Stage):
         return state, self.fn(batch)
 
 
-class _DistinctStage(Stage):
-    """Stateful distinct on (src, dst) endpoint pairs.
+def _value_bits(val) -> jax.Array:
+    """Lossless int32 view of a per-edge scalar value for whole-edge dedup.
 
-    Mirrors DistinctEdgeMapper's per-key HashSet (SimpleEdgeStream.java:309-323)
-    with a device neighbor table.  Note: the reference's set is over the whole
-    Edge including its value; the array-native summary dedupes by endpoints —
-    a deliberate re-design (values ride along with the first occurrence).
+    Exact bit equality (the dense analog of the reference HashSet's
+    value-based equals): <=32-bit leaves bitcast/cast without collision.
+    Multi-leaf or >32-bit values have no sound dense form (hashing could
+    collide and silently drop genuinely distinct edges) — refuse loudly.
+    """
+    leaves = jax.tree.leaves(val)
+    if len(leaves) != 1 or leaves[0].ndim != 1:
+        raise ValueError(
+            "whole-edge distinct needs a single scalar value per edge; "
+            "use distinct(by='endpoints') or map the values into one "
+            "<=32-bit scalar first (map_edges)"
+        )
+    leaf = leaves[0]
+    dt = jnp.dtype(leaf.dtype)
+    if dt.itemsize > 4:
+        raise ValueError(
+            f"whole-edge distinct supports values of <= 32 bits (got {dt}); "
+            "use distinct(by='endpoints') or narrow the values (map_edges)"
+        )
+    # issubdtype (not dtype.kind) so bfloat16/float8 — numpy kind 'V' — hit
+    # the bitcast branch: astype would TRUNCATE them (1.5 and 1.0 both -> 1)
+    # and silently merge genuinely distinct edges
+    if jnp.issubdtype(dt, jnp.floating):
+        width_int = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32}[dt.itemsize]
+        return jax.lax.bitcast_convert_type(leaf, width_int).astype(jnp.int32)
+    if jnp.issubdtype(dt, jnp.integer) or dt.kind == "b":
+        return leaf.astype(jnp.int32)
+    raise ValueError(
+        f"whole-edge distinct cannot form exact bits for dtype {dt}; "
+        "use distinct(by='endpoints') or map the values (map_edges)"
+    )
+
+
+class _DistinctStage(Stage):
+    """Stateful distinct mirroring DistinctEdgeMapper's per-key HashSet
+    (SimpleEdgeStream.java:309-323) with device neighbor tables.
+
+    The reference's set is over the whole Edge INCLUDING its value, so the
+    default (``edge`` mode) dedupes (src, dst, value) triples via two
+    slot-aligned tables (ops/neighbors.insert_unique_valued_batch) —
+    value-less batches behave exactly like endpoint dedup there (their
+    value bits are the constant 0).  Streams the source KNOWS are
+    value-less resolve ``auto`` to the single-table ``endpoints`` mode
+    instead (same semantics, half the state); callers can force
+    ``endpoints`` on valued streams for first-value-wins endpoint-pair
+    semantics.  Batches must be value-structure-homogeneous within one
+    stream — a stream mixing value-less and valued batches is ill-typed
+    (as in the reference: Edge<K, NullValue> and Edge<K, Double> streams
+    cannot union), and in such a stream a value-less edge would collide
+    with a 0-valued one.
     """
 
+    def __init__(self, mode: str):
+        assert mode in ("edge", "endpoints"), mode
+        self.mode = mode
+
     def init(self, cfg):
-        return neighbors.init_table(cfg.vertex_capacity, cfg.max_degree)
+        table = neighbors.init_table(cfg.vertex_capacity, cfg.max_degree)
+        if self.mode == "endpoints":
+            return table
+        return (table, neighbors.init_table(cfg.vertex_capacity, cfg.max_degree))
 
     def apply(self, state, batch):
-        table, is_new = neighbors.insert_unique_batch(
-            state, batch.src, batch.dst, batch.mask
+        if self.mode == "endpoints":
+            table, is_new = neighbors.insert_unique_batch(
+                state, batch.src, batch.dst, batch.mask
+            )
+            return table, batch.replace(mask=is_new)
+        table, vtable = state
+        bits = (
+            jnp.zeros(batch.src.shape, jnp.int32)
+            if batch.val is None
+            else _value_bits(batch.val)
         )
-        return table, batch.replace(mask=is_new)
+        table, vtable, is_new = neighbors.insert_unique_valued_batch(
+            table, vtable, batch.src, batch.dst, bits, batch.mask
+        )
+        return (table, vtable), batch.replace(mask=is_new)
 
 
 # ---------------------------------------------------------------------------
@@ -100,10 +164,17 @@ class EdgeStream:
         stages: Tuple[Stage, ...] = (),
         wire_arrays: Optional[Tuple[np.ndarray, np.ndarray, int]] = None,
         wire_packed: Optional[tuple] = None,
+        valued: Optional[bool] = None,
     ):
         self._source_factory = source_factory
         self.cfg = cfg
         self._stages = stages
+        # Does this stream carry edge values?  True / False when the source
+        # knows (collections, arrays, files), None for opaque batch sources.
+        # Consumers that must pick a state layout BEFORE seeing a batch
+        # (distinct's whole-edge mode) read this; None means "assume it
+        # might" (safe, costs an extra value table).
+        self._valued = valued
         # (src, dst, batch_size) host arrays backing the packed-wire fast path
         # (core/aggregation.py): present only for value-less, untimed sources,
         # and preserved through stage-adding transforms (stages run in-jit
@@ -132,6 +203,7 @@ class EdgeStream:
         """
         edges = list(edges)
         bs = batch_size or (len(edges) if edges else 1)
+        has_val = bool(edges) and len(edges[0]) >= 3
 
         def factory():
             for i in range(0, max(len(edges), 1), bs):
@@ -140,7 +212,7 @@ class EdgeStream:
                     return
                 yield EdgeBatch.from_edges(chunk, pad_to=bs, with_time=with_time)
 
-        return EdgeStream(factory, cfg)
+        return EdgeStream(factory, cfg, valued=has_val)
 
     @staticmethod
     def from_batches(
@@ -190,7 +262,7 @@ class EdgeStream:
                     return
                 yield EdgeBatch.from_arrays(chunk_s, dst[i : i + bs], pad_to=bs)
 
-        return EdgeStream(factory, cfg, wire_arrays=(src, dst, bs))
+        return EdgeStream(factory, cfg, wire_arrays=(src, dst, bs), valued=False)
 
     @staticmethod
     def from_wire(
@@ -298,16 +370,20 @@ class EdgeStream:
                 yield EdgeBatch.from_arrays(tail[0], tail[1], pad_to=batch_size)
 
         return EdgeStream(
-            factory, cfg, wire_packed=(bufs, batch_size, width, tail)
+            factory,
+            cfg,
+            wire_packed=(bufs, batch_size, width, tail),
+            valued=False,
         )
 
-    def _with(self, stage: Stage) -> "EdgeStream":
+    def _with(self, stage: Stage, valued: Optional[bool] = None) -> "EdgeStream":
         return EdgeStream(
             self._source_factory,
             self.cfg,
             self._stages + (stage,),
             wire_arrays=self._wire_arrays,
             wire_packed=self._wire_packed,
+            valued=self._valued if valued is None else valued,
         )
 
     # ---- transformations (lazy) --------------------------------------------
@@ -322,7 +398,7 @@ class EdgeStream:
         def tx(batch: EdgeBatch) -> EdgeBatch:
             return batch.replace(val=fn(batch.src, batch.dst, batch.val))
 
-        return self._with(_Stateless(tx))
+        return self._with(_Stateless(tx), valued=True)
 
     def filter_edges(self, pred: Callable) -> "EdgeStream":
         """Keep edges where pred(src, dst, val) is True (SimpleEdgeStream.java:290)."""
@@ -353,9 +429,22 @@ class EdgeStream:
         Doubles the static batch size."""
         return self._with(_Stateless(lambda b: b.concat(b.reversed())))
 
-    def distinct(self) -> "EdgeStream":
-        """Drop edges whose endpoint pair was seen before (SimpleEdgeStream.java:301-323)."""
-        return self._with(_DistinctStage())
+    def distinct(self, by: str = "auto") -> "EdgeStream":
+        """Drop duplicate edges (SimpleEdgeStream.java:301-323).
+
+        Matches the reference's whole-Edge dedup (including the value) by
+        default: ``by="auto"`` picks the two-table whole-edge mode unless
+        the source is KNOWN value-less, where the single-table endpoint
+        mode is identical semantics at half the state.  ``by="edge"``
+        forces whole-edge; ``by="endpoints"`` forces endpoint-pair dedup
+        (first occurrence's value wins — a deliberate semantic deviation
+        for valued multigraphs, explicit by construction).
+        """
+        if by not in ("auto", "edge", "endpoints"):
+            raise ValueError(f"unknown distinct mode {by!r}")
+        if by == "auto":
+            by = "endpoints" if self._valued is False else "edge"
+        return self._with(_DistinctStage(by))
 
     def union(self, other: "EdgeStream") -> "EdgeStream":
         """Merge two edge streams (SimpleEdgeStream.java:343).  Batches from
@@ -369,7 +458,11 @@ class EdgeStream:
             for batch in _round_robin(its):
                 yield batch
 
-        return EdgeStream(factory, self.cfg)
+        if left._valued is None or right._valued is None:
+            merged_valued = True if (left._valued or right._valued) else None
+        else:
+            merged_valued = left._valued or right._valued
+        return EdgeStream(factory, self.cfg, valued=merged_valued)
 
     # ---- execution ----------------------------------------------------------
 

@@ -97,3 +97,37 @@ def gather_rows(table: NeighborTable, vertices: jax.Array) -> Tuple[jax.Array, j
     rows = table.nbrs[vertices]
     valid = jnp.arange(table.nbrs.shape[1])[None, :] < table.deg[vertices][:, None]
     return rows, valid
+
+
+def insert_unique_valued_batch(
+    table: NeighborTable,
+    vtable: NeighborTable,
+    src: jax.Array,
+    dst: jax.Array,
+    val_bits: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[NeighborTable, NeighborTable, jax.Array]:
+    """Whole-EDGE distinct: a row is new iff its (src, dst, value) triple is.
+
+    Two slot-aligned neighbor tables carry the per-src entries — ``table``
+    stores the dst ids, ``vtable`` the int32-bitcast edge values.  Both are
+    driven by the same insert mask, so their degrees and slot layouts stay
+    identical by construction and presence is a same-slot conjunction.
+    This is the dense-array form of the reference's per-key HashSet over
+    whole Edges (SimpleEdgeStream.java:309-323).
+    """
+    if mask is None:
+        mask = jnp.ones(src.shape, bool)
+    rows_d, valid = gather_rows(table, src)
+    rows_v = vtable.nbrs[src]
+    present = jnp.any(
+        (rows_d == dst[:, None]) & (rows_v == val_bits[:, None]) & valid,
+        axis=1,
+    )
+    first = segments.first_occurrence_mask_triples(src, dst, val_bits, mask)
+    is_new = mask & ~present & first
+    return (
+        insert_batch(table, src, dst, is_new),
+        insert_batch(vtable, src, val_bits, is_new),
+        is_new,
+    )

@@ -84,22 +84,33 @@ def _rank_from_grouping(order: jax.Array, boundary: jax.Array) -> jax.Array:
     return jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
 
 
-def _pair_order(
-    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array]
+def _multi_order(
+    src: jax.Array, cols: Tuple[jax.Array, ...], mask: Optional[jax.Array]
 ) -> Tuple[jax.Array, jax.Array]:
-    """Stable order grouping equal (src, dst) pairs; returns (order, boundary).
+    """Stable order grouping equal (src, *cols) composites; returns
+    (order, boundary).
 
-    Uses lexsort on (position, dst, grouping-src) so stability is explicit and
-    no int64 composite key is needed.
+    Uses lexsort on (position, cols reversed, grouping-src) so stability is
+    explicit and no int64 composite key is needed; only ``src`` needs the
+    padding-safe grouping key (one differing column suffices to split a
+    group, and padding rows already split on src).
     """
     n = src.shape[0]
     ks = _grouping_key(src, mask)
     pos = jnp.arange(n, dtype=jnp.int32)
-    order = jnp.lexsort((pos, dst.astype(jnp.int32), ks))
-    s_sorted = ks[order]
-    d_sorted = dst.astype(jnp.int32)[order]
-    boundary = segment_boundaries(s_sorted) | segment_boundaries(d_sorted)
+    cols32 = tuple(c.astype(jnp.int32) for c in cols)
+    order = jnp.lexsort((pos,) + tuple(reversed(cols32)) + (ks,))
+    boundary = segment_boundaries(ks[order])
+    for c in cols32:
+        boundary = boundary | segment_boundaries(c[order])
     return order, boundary
+
+
+def _pair_order(
+    src: jax.Array, dst: jax.Array, mask: Optional[jax.Array]
+) -> Tuple[jax.Array, jax.Array]:
+    """Stable order grouping equal (src, dst) pairs; returns (order, boundary)."""
+    return _multi_order(src, (dst,), mask)
 
 
 def occurrence_rank_pairs(
@@ -138,3 +149,23 @@ def segment_boundaries(sorted_keys: jax.Array) -> jax.Array:
     return jnp.concatenate(
         [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]]
     )
+
+
+def first_occurrence_mask_triples(
+    src: jax.Array,
+    dst: jax.Array,
+    third: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """True for the first valid occurrence of each (src, dst, third) triple.
+
+    The whole-edge analog of ``first_occurrence_mask_pairs`` (reference
+    dedup is over the Edge INCLUDING its value,
+    SimpleEdgeStream.java:309-323): ``third`` is an arbitrary int32 column
+    (e.g. bitcast edge values) lexsorted alongside the endpoints.
+    """
+    order, boundary = _multi_order(src, (dst, third), mask)
+    first = _rank_from_grouping(order, boundary) == 0
+    if mask is not None:
+        first = first & mask
+    return first

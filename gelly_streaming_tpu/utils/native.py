@@ -155,3 +155,41 @@ def load_ingest_lib():
             lib.pack_edges_ef40.restype = ctypes.c_int64
         _lib = lib
         return _lib
+
+
+def sync_packaging_copy() -> bool:
+    """Copy the authoritative C++ source (native/edge_parser.cpp) over the
+    pip-packaging copy (gelly_streaming_tpu/native_src/edge_parser.cpp).
+
+    ``native/`` is the ONE source of truth; the package-data copy exists
+    only so pip installs keep the native ingest path.  A guard test
+    (tests/test_native_source_sync.py) fails whenever the two differ, and
+    this helper (``python -m gelly_streaming_tpu.utils.native --sync``) is
+    the prescribed fix.  Returns True when a copy was needed.
+    """
+    import shutil
+
+    src = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+    dst = os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp")
+    with open(src, "rb") as f:
+        want = f.read()
+    try:
+        with open(dst, "rb") as f:
+            have = f.read()
+    except OSError:
+        have = None
+    if have == want:
+        return False
+    shutil.copyfile(src, dst)
+    return True
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    if "--sync" in _sys.argv:
+        print(
+            "packaging copy updated"
+            if sync_packaging_copy()
+            else "packaging copy already in sync"
+        )

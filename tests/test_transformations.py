@@ -179,3 +179,88 @@ def test_epoch_timestamps_guard_covers_from_edges_and_tracers():
 
     out = jax.jit(build)(np.array([7], np.int64))
     assert int(out[0]) == 7
+
+
+def test_distinct_valued_stream_contract():
+    """VERDICT r3 item 8: the reference dedupes the whole Edge INCLUDING
+    its value (SimpleEdgeStream.java:309-323).  distinct() now matches it
+    for valued streams by default (two same-endpoint edges with different
+    values both survive; an exact repeat is dropped), with
+    by='endpoints' as the explicit first-value-wins deviation."""
+    import pytest
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    cfg = StreamConfig(vertex_capacity=16, batch_size=4)
+    valued = [(1, 2, 10.0), (1, 2, 20.0), (1, 2, 10.0), (3, 4, 30.0)]
+    # default = whole-edge (reference semantics): the exact repeat drops,
+    # the different-value edge on the same endpoints survives
+    edges = EdgeStream.from_collection(valued, cfg).distinct().collect_edges()
+    assert [(s, d, v) for s, d, v in edges] == [
+        (1, 2, 10.0),
+        (1, 2, 20.0),
+        (3, 4, 30.0),
+    ]
+    # cross-batch memory of (pair, value): repeat in a later batch drops too
+    edges2 = (
+        EdgeStream.from_collection(valued, cfg, batch_size=2)
+        .distinct()
+        .collect_edges()
+    )
+    assert edges2 == edges
+    # explicit opt-in: endpoint-pair dedup, first occurrence's value wins
+    ep = (
+        EdgeStream.from_collection(valued, cfg)
+        .distinct(by="endpoints")
+        .collect_edges()
+    )
+    assert [(s, d, v) for s, d, v in ep] == [(1, 2, 10.0), (3, 4, 30.0)]
+    with pytest.raises(ValueError, match="unknown distinct mode"):
+        EdgeStream.from_collection(valued, cfg).distinct(by="pair")
+    # multi-leaf / wide values have no sound dense whole-edge form: loud
+    with pytest.raises(ValueError, match="single scalar value"):
+        (
+            EdgeStream.from_collection(valued, cfg)
+            .map_edges(lambda s, d, v: (v, v))
+            .distinct()
+            .collect_edges()
+        )
+
+
+def test_distinct_value_less_stream_uses_single_table():
+    """Known value-less sources resolve auto -> endpoint mode (identical
+    semantics, half the state)."""
+    import numpy as np
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream, _DistinctStage
+
+    cfg = StreamConfig(vertex_capacity=16, batch_size=4)
+    src = np.array([1, 1, 3], np.int32)
+    dst = np.array([2, 2, 4], np.int32)
+    stream = EdgeStream.from_arrays(src, dst, cfg).distinct()
+    stage = stream._stages[-1]
+    assert isinstance(stage, _DistinctStage) and stage.mode == "endpoints"
+    assert [e[:2] for e in stream.collect_edges()] == [(1, 2), (3, 4)]
+
+
+def test_distinct_whole_edge_bf16_values_bitcast_exactly():
+    """bfloat16 (numpy dtype kind 'V') must hit the BITCAST branch — astype
+    truncation would merge genuinely distinct values (review finding)."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    cfg = StreamConfig(vertex_capacity=16, batch_size=4)
+    stream = (
+        EdgeStream.from_collection(
+            [(1, 2, 1.5), (1, 2, 1.0), (1, 2, 1.5)], cfg
+        )
+        .map_edges(lambda s, d, v: v.astype(jnp.bfloat16))
+        .distinct()
+    )
+    edges = stream.collect_edges()
+    # 1.5 and 1.0 are distinct bf16 edges; the exact 1.5 repeat drops
+    assert len(edges) == 2
