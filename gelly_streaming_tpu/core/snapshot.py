@@ -369,13 +369,37 @@ class SnapshotStream:
         return OutputStream(records)
 
     def apply_on_neighbors(
-        self, apply_fn: Callable, post: Optional[Callable] = None
+        self,
+        apply_fn: Callable,
+        post: Optional[Callable] = None,
+        mode: str = "device",
     ) -> OutputStream:
-        """Per key, run a whole-neighborhood kernel:
-        apply_fn(vid, nbr_ids [D], vals [D], valid [D]) -> record pytree
-        (reference SnapshotFunction wrapping EdgesApply, SnapshotStream.java:129-181;
-        the lazy neighbor Iterable becomes the padded row).  ``post`` maps the
-        host record before emission (e.g. jax bool -> "big"/"small" strings)."""
+        """Per key, run a whole-neighborhood function
+        (reference SnapshotFunction wrapping EdgesApply,
+        SnapshotStream.java:129-181).
+
+        ``mode="device"`` (default): ``apply_fn(vid, nbr_ids [D], vals [D],
+        valid [D]) -> record pytree`` is a jax-traceable kernel vmapped over
+        the degree-bucketed padded rows — the lazy neighbor Iterable becomes
+        the padded row.  ``post`` maps the host record before emission (e.g.
+        jax bool -> "big"/"small" strings).
+
+        ``mode="host"`` is the escape hatch for truly irregular,
+        NON-traceable UDFs (SURVEY §7; the reference's EdgesApply accepts
+        arbitrary Java code over a lazy iterator, EdgesApply.java:47):
+        ``apply_fn(vid, neighbors)`` runs as plain Python per vertex, where
+        ``neighbors`` is a list of ``(nbr_id, val)`` tuples (``val`` None on
+        value-less streams) in neighborhood order — the direct analog of
+        the reference's ``Iterable<Tuple2<nbrId, edgeVal>>``.  It may
+        return one record or a list of records (the collector analog:
+        emit 0..n).  Neighborhood grouping still runs on device; only the
+        UDF itself runs on host, so throughput is Python-bound — keep hot
+        aggregations on the device path.
+        """
+        if mode not in ("device", "host"):
+            raise ValueError(f"unknown apply_on_neighbors mode {mode!r}")
+        if mode == "host":
+            return self._apply_on_neighbors_host(apply_fn, post)
 
         def kernel(keys, nbrs, vals, valid):
             return jax.vmap(apply_fn)(keys, nbrs, vals, valid)
@@ -391,5 +415,51 @@ class SnapshotStream:
                     if post is not None:
                         rec = post(rec)
                     yield rec if isinstance(rec, tuple) else (rec,)
+
+        return OutputStream(records)
+
+    def _apply_on_neighbors_host(
+        self, apply_fn: Callable, post: Optional[Callable]
+    ) -> OutputStream:
+        """Host-mode neighborhood apply: arbitrary Python per vertex."""
+
+        def records():
+            for hood in self._neighborhood_panes():
+                keys = np.asarray(hood.keys)
+                nbrs = np.asarray(hood.nbrs)
+                valid = np.asarray(hood.valid)
+                vals = (
+                    None
+                    if hood.vals is None
+                    else jax.tree.map(np.asarray, hood.vals)
+                )
+                leaves = None if vals is None else jax.tree.leaves(vals)
+                treedef = None if vals is None else jax.tree.structure(vals)
+                for i in range(hood.num_keys):
+                    sel = valid[i]
+                    row = nbrs[i][sel]
+                    if vals is None:
+                        neighbors = [(int(nb), None) for nb in row]
+                    else:
+                        # mask each leaf ONCE per vertex (not per neighbor:
+                        # that would be O(D^2) on hub vertices)
+                        masked = [leaf[i][sel] for leaf in leaves]
+                        neighbors = [
+                            (
+                                int(nb),
+                                jax.tree.unflatten(
+                                    treedef, [m[j].item() for m in masked]
+                                ),
+                            )
+                            for j, nb in enumerate(row)
+                        ]
+                    out = apply_fn(int(keys[i]), neighbors)
+                    if out is None:
+                        continue
+                    outs = out if isinstance(out, list) else [out]
+                    for rec in outs:
+                        if post is not None:
+                            rec = post(rec)
+                        yield rec if isinstance(rec, tuple) else (rec,)
 
         return OutputStream(records)

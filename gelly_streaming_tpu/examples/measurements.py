@@ -292,6 +292,87 @@ def measure_matching(args) -> dict:
     }
 
 
+def measure_routing(args) -> dict:
+    """Skew robustness of the device keyBy plane (SURVEY §7 "skewed keys"):
+    route a zipf-keyed batch over the mesh with plain ``device_route`` vs
+    ``device_route_salted`` and report the drop counts and per-shard
+    receive imbalance.  The reference's keyBy has no answer to hot keys
+    (every record of a key lands on one subtask); the salted router spreads
+    each key's occurrences across shards for associative aggregation.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from gelly_streaming_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        make_mesh,
+        shard_map,
+    )
+    from gelly_streaming_tpu.parallel.routing import (
+        device_route,
+        device_route_salted,
+    )
+
+    s_n = args.shards
+    if len(jax.devices()) < s_n:
+        return {"skipped": f"need {s_n} devices, have {len(jax.devices())}"}
+    per_shard = args.batch
+    cap = args.capacity
+    rng = np.random.default_rng(args.seed)
+    # zipf keys clipped into the vertex space: a heavy head (hub vertices)
+    # plus a long tail — the power-law shape that breaks plain keyBy
+    keys = np.minimum(
+        rng.zipf(args.alpha, size=(s_n, per_shard)) - 1, args.vertices - 1
+    ).astype(np.int32)
+    dst = rng.integers(0, args.vertices, (s_n, per_shard)).astype(np.int32)
+    mask = np.ones((s_n, per_shard), bool)
+    mesh = make_mesh(s_n)
+    spec = P(SHARD_AXIS)
+
+    def run(router):
+        def step(src, dst, m):
+            r_src, r_dst, r_mask, dropped = router(
+                src[0], dst[0], m[0], s_n, cap
+            )
+            recv = jnp.sum(r_mask.astype(jnp.int32))
+            total_drop = jax.lax.psum(dropped, SHARD_AXIS)
+            return recv[None], total_drop[None]
+
+        fn = jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=(spec, spec),
+            )
+        )
+        recv, drop = fn(
+            jnp.asarray(keys), jnp.asarray(dst), jnp.asarray(mask)
+        )
+        recv = np.asarray(recv)
+        return int(np.asarray(drop)[0]), recv
+
+    plain_drop, plain_recv = run(device_route)
+    salt_drop, salt_recv = run(device_route_salted)
+
+    def imbalance(recv):
+        mean = recv.mean()
+        return float(recv.max() / mean) if mean else 0.0
+
+    return {
+        "metric": "zipf_routed_drops",
+        "shards": s_n,
+        "edges": int(s_n * per_shard),
+        "capacity_per_pair": cap,
+        "zipf_alpha": args.alpha,
+        "plain_dropped": plain_drop,
+        "salted_dropped": salt_drop,
+        "plain_recv_imbalance": round(imbalance(plain_recv), 2),
+        "salted_recv_imbalance": round(imbalance(salt_recv), 2),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="measurements", description=__doc__)
     sub = p.add_subparsers(dest="workload", required=True)
@@ -326,6 +407,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--vertices", type=int, default=1 << 20)
     sp.add_argument("--batch", type=int, default=1 << 20)
     sp.add_argument("--seed", type=int, default=0)
+    sp = sub.add_parser("routing")
+    sp.add_argument("--shards", type=int, default=8)
+    sp.add_argument("--batch", type=int, default=256, help="edges per shard")
+    sp.add_argument(
+        "--capacity", type=int, default=64,
+        help="per-(sender,receiver) bucket capacity",
+    )
+    sp.add_argument("--vertices", type=int, default=1 << 12)
+    sp.add_argument("--alpha", type=float, default=1.3, help="zipf exponent")
+    sp.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     fn = {
         "degrees": measure_degrees,
@@ -334,6 +425,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "spanner": measure_spanner,
         "matching": measure_matching,
         "replay": measure_replay,
+        "routing": measure_routing,
     }[args.workload]
     print(json.dumps(fn(args)))
 

@@ -137,10 +137,26 @@ def block_sharded_cc_round(
     missing #4; Flink's keyed state is likewise partitioned per subtask,
     never replicated, SimpleEdgeStream.java:119).
 
-    The round: lookup both endpoint labels (ring pass 1), scatter-min the
-    per-edge minima into both owners (ring pass 2), then pointer-halve every
-    local row (label <- label[label], ring pass 3) — the lazy compression
-    that propagates earlier merges to vertices no new edge touches.
+    The round: lookup both endpoint labels (ring pass 1), HOOK — scatter
+    each edge's smaller root into its larger root's row,
+    ``label[max(ru, rv)] <- min(ru, rv)`` (ring pass 2) — then
+    pointer-halve every local row (label <- label[label], ring pass 3),
+    the lazy compression that propagates merges to vertices no new edge
+    touches.
+
+    Hooking ROOT rows only (never the endpoints) is load-bearing for
+    MULTI-PANE streams: writing a new minimum straight into an endpoint's
+    row would sever that endpoint's pointer to its previous root — e.g.
+    with label[1002]=222 from an earlier pane, edges (1002,128) and
+    (222,50) folding in one round would drop 1002 to 128 and 222 to 50,
+    losing the 1002->222 witness that ties {128,1002} to 50's component.
+    The Shiloach-Vishkin-style root hook keeps 1002->222 intact (only
+    row 222, then row 128 via later rounds, takes new minima), and halving
+    re-compresses endpoints afterwards.  Labels are non-increasing and
+    every written value is a label from the same component, so the
+    fixpoint loop below stays sound and terminating; at a halving-stable
+    fixpoint every label is a self-fixed root, so an unmergeable hook
+    (l[max] <= min with l[max] = max) forces equal endpoint roots.
     """
     from gelly_streaming_tpu.parallel.ring import ring_lookup, ring_scatter_min
 
@@ -149,10 +165,15 @@ def block_sharded_cc_round(
     q = jnp.concatenate([src, dst])
     m2 = jnp.concatenate([mask, mask])
     labels = ring_lookup(label_local, jnp.where(m2, q, 0), num_shards, axis_name)
-    cand = jnp.minimum(labels[:e], labels[e:])
-    val2 = jnp.where(m2, jnp.concatenate([cand, cand]), big)
+    ru, rv = labels[:e], labels[e:]
+    lo = jnp.minimum(ru, rv)
+    hi = jnp.maximum(ru, rv)
     label_local = ring_scatter_min(
-        label_local, jnp.where(m2, q, 0), val2, num_shards, axis_name
+        label_local,
+        jnp.where(mask, hi, 0),
+        jnp.where(mask, lo, big),
+        num_shards,
+        axis_name,
     )
     # pointer halving: label values are global ids, so their current labels
     # live on their owners — one more ring pass compresses every local row
@@ -314,10 +335,18 @@ class BlockShardedCC:
         from the start, already-folded panes are skipped by window id, state
         is exactly-once and emissions at-least-once — labels only ever
         decrease, so a replayed fold is also idempotent by construction.
-        A snapshot downloads the full [C] label table to this process
-        (int32: 4 bytes/vertex per pane close); single-process meshes only —
-        a multi-process mesh has non-addressable shards and needs a
-        per-process (orbax-style) save, which this runner does not implement.
+
+        Snapshot layout scales with the mesh topology: a single-process
+        mesh downloads the full [S, C/S] table (int32: 4 bytes/vertex per
+        pane close); a MULTI-PROCESS mesh saves per process — each host
+        writes only its ADDRESSABLE shard rows to
+        ``{checkpoint_path}.proc{K}`` (the orbax-style per-host shard save
+        the reference's repartitioning TODO never built,
+        SummaryAggregation.java:121-135), so no host ever materializes
+        another host's blocks.  Restore requires the same process-to-shard
+        topology; every process must hold a consistent snapshot (same
+        position) or all start fresh together (agreement via one
+        process_allgather round).
         """
         from gelly_streaming_tpu.core.windows import stream_panes
 
@@ -336,44 +365,41 @@ class BlockShardedCC:
             from jax.sharding import PartitionSpec as P
 
             sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
-            if checkpoint_path:
-                import jax as _jax
-
-                if _jax.process_count() > 1:
-                    raise NotImplementedError(
-                        "BlockShardedCC checkpointing gathers the label "
-                        "table to one process; multi-process meshes need a "
-                        "per-process snapshot (not implemented)"
-                    )
+            multi = jax.process_count() > 1
             start_after = -1
             global_done = False
-            label_host = None
+            label = None
             if checkpoint_path and restore:
-                from gelly_streaming_tpu.utils.checkpoint import (
-                    checkpoint_exists,
-                    load_state,
-                )
+                if multi:
+                    label, start_after, global_done = self._restore_per_process(
+                        cfg, checkpoint_path, sharding
+                    )
+                else:
+                    from gelly_streaming_tpu.utils.checkpoint import (
+                        checkpoint_exists,
+                        load_state,
+                    )
 
-                if checkpoint_exists(checkpoint_path):
-                    try:
-                        snap = load_state(
-                            checkpoint_path, self._checkpoint_like(cfg)
-                        )
-                    except ValueError:
-                        snap = None  # mismatched/legacy layout: start fresh
-                    if snap is not None:
-                        label_host = np.asarray(snap["labels"])
-                        start_after = int(snap["last_window"])
-                        global_done = bool(snap["global_done"])
+                    if checkpoint_exists(checkpoint_path):
+                        try:
+                            snap = load_state(
+                                checkpoint_path, self._checkpoint_like(cfg)
+                            )
+                        except ValueError:
+                            snap = None  # mismatched/legacy: start fresh
+                        if snap is not None:
+                            label = jax.device_put(
+                                np.asarray(snap["labels"]), sharding
+                            )
+                            start_after = int(snap["last_window"])
+                            global_done = bool(snap["global_done"])
             # block-distributed from the first byte: the [S, C/S] table goes
             # straight to its owners (committing it to one device first would
             # reintroduce the O(C)-per-chip footprint this class removes)
-            label = jax.device_put(
-                label_host
-                if label_host is not None
-                else init_label_blocks(cfg.vertex_capacity, n),
-                sharding,
-            )
+            if label is None:
+                label = jax.device_put(
+                    init_label_blocks(cfg.vertex_capacity, n), sharding
+                )
             pane_iter = (
                 panes() if panes is not None else stream_panes(stream, window_ms)
             )
@@ -396,18 +422,137 @@ class BlockShardedCC:
                 start_after = max(pane.window_id, start_after)
                 global_done = global_done or pane.window_id == -1
                 if checkpoint_path:
-                    from gelly_streaming_tpu.utils.checkpoint import save_state
+                    if multi:
+                        self._save_per_process(
+                            checkpoint_path, label, start_after, global_done
+                        )
+                    else:
+                        from gelly_streaming_tpu.utils.checkpoint import (
+                            save_state,
+                        )
 
-                    save_state(
-                        checkpoint_path,
-                        {
-                            "labels": np.asarray(label),
-                            "last_window": np.full((), start_after, np.int64),
-                            "global_done": np.full((), global_done, bool),
-                        },
-                    )
+                        save_state(
+                            checkpoint_path,
+                            {
+                                "labels": np.asarray(label),
+                                "last_window": np.full(
+                                    (), start_after, np.int64
+                                ),
+                                "global_done": np.full((), global_done, bool),
+                            },
+                        )
 
         return OutputStream(records)
+
+    @staticmethod
+    def _proc_file(checkpoint_path: str) -> str:
+        base = (
+            checkpoint_path[: -len(".npz")]
+            if checkpoint_path.endswith(".npz")
+            else checkpoint_path
+        )
+        return f"{base}.proc{jax.process_index()}.npz"
+
+    def _save_per_process(
+        self, checkpoint_path: str, label, start_after: int, global_done: bool
+    ) -> None:
+        """Each process saves ONLY its addressable shard rows (+ position)."""
+        from gelly_streaming_tpu.utils.checkpoint import save_state
+
+        shards = sorted(label.addressable_shards, key=lambda s: s.index[0].start)
+        rows = np.array([s.index[0].start for s in shards], np.int64)
+        blocks = np.stack([np.asarray(s.data)[0] for s in shards])
+        save_state(
+            self._proc_file(checkpoint_path),
+            {
+                "rows": rows,
+                "blocks": blocks,
+                "last_window": np.full((), start_after, np.int64),
+                "global_done": np.full((), global_done, bool),
+            },
+        )
+
+    def _restore_per_process(self, cfg, checkpoint_path: str, sharding):
+        """Rebuild the sharded label table from per-process snapshots.
+
+        Every process loads only its own file; validity (file present,
+        layout ok, rows matching this process's addressable shards) and the
+        stream position must AGREE across processes — one
+        ``process_allgather`` round decides; any inconsistency means all
+        start fresh together (a split restore would deadlock the lockstep
+        fold).  Returns (label | None, start_after, global_done).
+        """
+        from jax.experimental import multihost_utils
+
+        from gelly_streaming_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_state,
+        )
+
+        n = self.num_shards
+        block = cfg.vertex_capacity // n
+        snap = None
+        path = self._proc_file(checkpoint_path)
+        # this process's addressable shard count fixes the snapshot shapes
+        # (load_state validates layout exactly)
+        k = sum(
+            1
+            for d in self.mesh.devices.flat
+            if d.process_index == jax.process_index()
+        )
+        if checkpoint_exists(path):
+            try:
+                like = {
+                    "rows": np.zeros((k,), np.int64),
+                    "blocks": np.zeros((k, block), np.int32),
+                    "last_window": np.zeros((), np.int64),
+                    "global_done": np.zeros((), bool),
+                }
+                snap = load_state(path, like)
+            except ValueError:
+                snap = None
+        # rows this process NOW owns under the mesh (row r lives on device r)
+        own_rows = {
+            r
+            for r, d in enumerate(self.mesh.devices.flat)
+            if d.process_index == jax.process_index()
+        }
+        ok = snap is not None and set(
+            int(r) for r in snap["rows"]
+        ) == own_rows
+        pos = int(snap["last_window"]) if ok else -1
+        done = bool(snap["global_done"]) if ok else False
+        # rows_match participates in the agreement: a topology change must
+        # fail on EVERY process (a split restore — one process raising while
+        # the others enter the pane fold — would deadlock the first ring
+        # collective)
+        agree = multihost_utils.process_allgather(
+            np.array([int(ok), pos, int(done)], np.int64)
+        )
+        if not (
+            agree[:, 0].all()
+            and (agree[:, 1] == agree[0, 1]).all()
+            and (agree[:, 2] == agree[0, 2]).all()
+        ):
+            return None, -1, False
+        row_to_block = {
+            int(r): snap["blocks"][i] for i, r in enumerate(snap["rows"])
+        }
+
+        def cb(index):
+            row = index[0].start or 0
+            blk = row_to_block.get(int(row))
+            if blk is None:
+                raise ValueError(
+                    f"per-process snapshot {path} holds rows "
+                    f"{sorted(row_to_block)} but this process now owns row "
+                    f"{row}: restore requires the same process-to-shard "
+                    "topology the snapshot was written under"
+                )
+            return blk[None]
+
+        label = jax.make_array_from_callback((n, block), sharding, cb)
+        return label, int(agree[0, 1]), bool(agree[0, 2])
 
 
 def sharded_cc_fixpoint(parent, src, dst, mask, axis_name: str = SHARD_AXIS):

@@ -207,3 +207,53 @@ def test_block_sharded_cc_under_supervisor(tmp_path):
     np.testing.assert_array_equal(
         unshard_labels(got[-1][0]), unshard_labels(clean[-1][0])
     )
+
+
+def test_block_sharded_cc_multi_pane_cross_pane_merges():
+    """Regression (round 4): hooking must write the smaller ROOT into the
+    larger root's row, never new minima into endpoint rows — endpoint
+    writes sever the pointer that witnesses an earlier pane's merge, so a
+    later pane connecting two old components left part of one component on
+    a stale label.  Random multi-pane streams over several seeds must match
+    a host union-find exactly."""
+    import numpy as np
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.core.types import EdgeBatch
+    from gelly_streaming_tpu.library.connected_components import (
+        BlockShardedCC,
+        unshard_labels,
+    )
+
+    C = 1 << 10
+    for seed in (11, 23, 47):
+        rng = np.random.default_rng(seed)
+        src = rng.integers(0, C, 256).astype(np.int32)
+        dst = rng.integers(0, C, 256).astype(np.int32)
+        cfg = StreamConfig(
+            vertex_capacity=C, batch_size=64, ingest_window_edges=80
+        )
+
+        def batches():
+            for i in range(0, 256, 64):
+                yield EdgeBatch.from_arrays(src[i : i + 64], dst[i : i + 64])
+
+        outs = list(BlockShardedCC().run(EdgeStream.from_batches(batches, cfg)))
+        assert len(outs) == 4  # 256 edges at 80/pane
+        labels = unshard_labels(outs[-1][0])
+
+        parent = np.arange(C)
+
+        def find(v):
+            while parent[v] != v:
+                parent[v] = parent[parent[v]]
+                v = parent[v]
+            return v
+
+        for a, b in zip(src, dst):
+            ra, rb = find(int(a)), find(int(b))
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+        expect = np.array([find(v) for v in range(C)])
+        assert np.array_equal(labels, expect), f"seed {seed}"

@@ -188,3 +188,61 @@ def test_slice_sharded_matches_single_random(seed, direction):
         return sorted(out.lines())
 
     assert run(sharded) == run(single), f"seed={seed} dir={direction}"
+
+
+def test_apply_on_neighbors_host_escape_hatch():
+    """SURVEY §7 / VERDICT r3 missing #3: mode='host' runs a plain-Python
+    (non-traceable) closure per vertex over the lazy-neighbor analog —
+    string building, the canonical thing a jax kernel cannot do.  Ref:
+    SnapshotStream.java:143-172 (arbitrary Java over an Iterable)."""
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    stream = long_long_stream()
+    out = list(
+        stream.slice(1000, EdgeDirection.OUT).apply_on_neighbors(
+            lambda vid, neighbors: f"{vid}:"
+            + "+".join(f"{nb}({val:g})" for nb, val in neighbors),
+            mode="host",
+        )
+    )
+    got = sorted(r[0] for r in out)
+    assert got == [
+        "1:2(12)+3(13)",
+        "2:3(23)",
+        "3:4(34)+5(35)",
+        "4:5(45)",
+        "5:1(51)",
+    ]
+
+
+def test_apply_on_neighbors_host_collector_and_valueless():
+    """Host mode supports 0..n emissions per vertex (the reference's
+    Collector) and value-less streams pass val=None per neighbor."""
+    import numpy as np
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    cfg = StreamConfig(vertex_capacity=16, batch_size=8)
+    src = np.array([1, 1, 2], np.int32)
+    dst = np.array([2, 3, 3], np.int32)
+
+    def wedges(vid, neighbors):
+        assert all(v is None for _, v in neighbors)
+        ids = [nb for nb, _ in neighbors]
+        return [(vid, a, b) for a in ids for b in ids if a < b]
+
+    out = list(
+        EdgeStream.from_arrays(src, dst, cfg)
+        .slice(1000, EdgeDirection.OUT)
+        .apply_on_neighbors(wedges, mode="host")
+    )
+    assert out == [(1, 2, 3)]
+
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown apply_on_neighbors mode"):
+        EdgeStream.from_arrays(src, dst, cfg).slice(
+            1000, EdgeDirection.OUT
+        ).apply_on_neighbors(wedges, mode="python")
