@@ -491,12 +491,25 @@ class EdgeStream:
 
         ``kernel(op_state, EdgeBatch) -> (op_state, outs)`` with ``outs`` a
         pytree of per-batch output arrays; ``init_fn(cfg)`` builds the op
-        state.  Yields ``outs`` (device arrays) per micro-batch.  When the
-        source is wire-backed the whole step — device-side unpack, stages,
-        kernel — is ONE jitted function fed by prefetched packed transfers
-        with the carry donated (the property-stream analog of the aggregate
-        fast path); otherwise it runs over the EdgeBatch source.
+        state.  Yields ``outs`` as HOST (numpy) pytrees per micro-batch,
+        with the device->host downloads pipelined ahead of the consumer
+        (io/wire.prefetch_to_host — async copies overlap later batches'
+        compute, so the emission plane is bounded by the downlink rate, not
+        per-batch round trips; VERDICT r3 weak #7).  When the source is
+        wire-backed the whole step — device-side unpack, stages, kernel —
+        is ONE jitted function fed by prefetched packed transfers with the
+        carry donated (the property-stream analog of the aggregate fast
+        path); otherwise it runs over the EdgeBatch source.
         """
+        from gelly_streaming_tpu.io import wire as _wire_mod
+
+        yield from _wire_mod.prefetch_to_host(
+            self._kernel_stream_device(init_fn, kernel),
+            depth=self.cfg.prefetch_depth,
+        )
+
+    def _kernel_stream_device(self, init_fn, kernel) -> Iterator:
+        """`_kernel_stream`'s device plane: yields per-batch DEVICE outs."""
         cfg = self.cfg
         stages = self._stages
         step_j, wire_j = self._kernel_step_jits(kernel)
@@ -610,8 +623,8 @@ class EdgeStream:
 
         def blocks():
             for v, new in self._kernel_stream(init, kernel):
-                idx = np.nonzero(np.asarray(new))[0]
-                yield RecordBlock((np.asarray(v)[idx], NULL))
+                idx = np.nonzero(new)[0]
+                yield RecordBlock((v[idx], NULL))
 
         return OutputStream(blocks_fn=blocks)
 
@@ -667,15 +680,16 @@ class EdgeStream:
             )
 
         def blocks():
+            # _kernel_stream pipelines the downloads (async copies overlap
+            # later batches' compute); outs arrive as numpy
             for outs in self._kernel_stream(init, kernel):
                 if packed_ok:
                     packed, maskbits = outs
                     ids, vals, m = wire_mod.unpack_records48(
-                        np.asarray(packed), np.asarray(maskbits), len(packed) // 6
+                        packed, maskbits, len(packed) // 6
                     )
                 else:
-                    v, emitted, msk = outs
-                    ids, vals, m = np.asarray(v), np.asarray(emitted), np.asarray(msk)
+                    ids, vals, m = outs
                 idx = np.nonzero(m)[0]
                 yield RecordBlock((ids[idx], vals[idx]))
 
@@ -699,8 +713,8 @@ class EdgeStream:
 
         def blocks():
             for running, new in self._kernel_stream(init, kernel):
-                idx = np.nonzero(np.asarray(new))[0]
-                yield RecordBlock((np.asarray(running)[idx],))
+                idx = np.nonzero(new)[0]
+                yield RecordBlock((running[idx],))
 
         return OutputStream(blocks_fn=blocks)
 
@@ -717,8 +731,8 @@ class EdgeStream:
 
         def blocks():
             for running, m in self._kernel_stream(init, kernel):
-                idx = np.nonzero(np.asarray(m))[0]
-                yield RecordBlock((np.asarray(running)[idx],))
+                idx = np.nonzero(m)[0]
+                yield RecordBlock((running[idx],))
 
         return OutputStream(blocks_fn=blocks)
 
