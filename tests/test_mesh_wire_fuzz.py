@@ -1,0 +1,147 @@
+"""Randomized differentials for the sharded streaming wire fold: arbitrary
+(edge count, batch size, shard count, encoding, tail) configurations must
+produce identical summaries to the single-shard wire fast path — the
+mesh plane is an execution strategy, never a semantics change."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.io import wire
+from gelly_streaming_tpu.library.bipartiteness import BipartitenessCheck
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_mesh_streaming_fold_matches_single_shard_fuzz(seed):
+    rng = np.random.default_rng(100 + seed)
+    c = int(rng.choice([32, 64, 128]))
+    n = int(rng.integers(1, 700))
+    batch = int(rng.choice([8, 16, 64, 128]))
+    shards = int(rng.choice([2, 4, 8]))
+    enc = rng.choice(["plain", "ef40"])
+    src = rng.integers(0, c, n).astype(np.int32)
+    dst = rng.integers(0, c, n).astype(np.int32)
+
+    single_cfg = StreamConfig(
+        vertex_capacity=c, batch_size=batch, wire_encoding=str(enc)
+    )
+    mesh_cfg = StreamConfig(
+        vertex_capacity=c,
+        batch_size=batch,
+        num_shards=shards,
+        wire_encoding=str(enc),
+    )
+    single = (
+        EdgeStream.from_arrays(src, dst, single_cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    mesh = (
+        EdgeStream.from_arrays(src, dst, mesh_cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert mesh[-1][0].components() == single[-1][0].components(), (
+        c, n, batch, shards, enc,
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_mesh_streaming_fold_replay_with_tail_fuzz(seed):
+    """from_wire replay (pre-packed buffers + raw tail) through the mesh."""
+    rng = np.random.default_rng(200 + seed)
+    c = 64
+    batch = int(rng.choice([16, 32]))
+    n = int(rng.integers(batch + 1, 500))
+    src = rng.integers(0, c, n).astype(np.int32)
+    dst = rng.integers(0, c, n).astype(np.int32)
+    width = wire.replay_width(c, batch)
+    bufs, tail = wire.pack_stream(src, dst, batch, width)
+
+    single = (
+        EdgeStream.from_wire(
+            bufs, batch, width, StreamConfig(vertex_capacity=c, batch_size=batch),
+            tail=tail,
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    mesh = (
+        EdgeStream.from_wire(
+            bufs, batch, width,
+            StreamConfig(vertex_capacity=c, batch_size=batch, num_shards=8),
+            tail=tail,
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert mesh[-1][0].components() == single[-1][0].components()
+
+
+def test_mesh_streaming_fold_bipartiteness_matches():
+    """The generic gather-combine is bypassed for BP too (collective
+    parity fixpoint); verdicts and candidate renderings must agree."""
+    rng = np.random.default_rng(7)
+    for odd in (False, True):
+        # random bipartite graph over two halves; optionally an odd chord
+        u = rng.integers(0, 16, 300).astype(np.int32)
+        v = (rng.integers(16, 32, 300)).astype(np.int32)
+        src = u
+        dst = v.copy()
+        if odd:
+            src = np.append(src, np.int32(3))
+            dst = np.append(dst, np.int32(5))  # both in the same half
+        single = (
+            EdgeStream.from_arrays(
+                src, dst, StreamConfig(vertex_capacity=32, batch_size=64)
+            )
+            .aggregate(BipartitenessCheck())
+            .collect()
+        )
+        mesh = (
+            EdgeStream.from_arrays(
+                src,
+                dst,
+                StreamConfig(vertex_capacity=32, batch_size=64, num_shards=8),
+            )
+            .aggregate(BipartitenessCheck())
+            .collect()
+        )
+        assert (
+            mesh[-1][0].is_bipartite()
+            == single[-1][0].is_bipartite()
+            == (not odd)
+        )
+        assert str(mesh[-1][0]) == str(single[-1][0])
+
+
+def test_whole_edge_distinct_fuzz_vs_python_set():
+    """Whole-edge distinct vs a plain Python set over (src, dst, value)
+    triples — arrival order, cross-batch memory, exact value equality."""
+    rng = np.random.default_rng(17)
+    for trial in range(4):
+        n = int(rng.integers(10, 400))
+        edges = [
+            (
+                int(rng.integers(0, 24)),
+                int(rng.integers(0, 24)),
+                float(rng.integers(0, 4)),  # few distinct values -> collisions
+            )
+            for _ in range(n)
+        ]
+        batch = int(rng.choice([4, 16, 64]))
+        cfg = StreamConfig(vertex_capacity=32, batch_size=batch, max_degree=128)
+        got = (
+            EdgeStream.from_collection(edges, cfg, batch_size=batch)
+            .distinct()
+            .collect_edges()
+        )
+        seen = set()
+        expect = []
+        for e in edges:
+            if e not in seen:
+                seen.add(e)
+                expect.append(e)
+        assert got == expect, (trial, n, batch)
