@@ -28,6 +28,9 @@ from gelly_streaming_tpu.core.windows import WindowPane, stream_panes
 from gelly_streaming_tpu.ops import neighborhoods as nbh_ops
 
 
+_NEEDS_VALUES_MSG = "this aggregation requires edge values; the stream has none"
+
+
 class Neighborhoods:
     """One degree bucket of a closed pane: padded [K, D] tensors.
 
@@ -171,9 +174,7 @@ class SnapshotStream:
             cache["jit"] = kernel
         for hood in self._neighborhood_panes():
             if needs_vals and hood.vals is None:
-                raise ValueError(
-                    "this aggregation requires edge values; the stream has none"
-                )
+                raise ValueError(_NEEDS_VALUES_MSG)
             out = kernel(
                 jnp.asarray(hood.keys),
                 jnp.asarray(hood.nbrs),
@@ -245,9 +246,7 @@ class SnapshotStream:
             if len(src) == 0:
                 continue
             if needs_vals and val is None:
-                raise ValueError(
-                    "this aggregation requires edge values; the stream has none"
-                )
+                raise ValueError(_NEEDS_VALUES_MSG)
             counts = np.bincount(src % s_n, minlength=s_n)
             cap = max(1, 1 << (int(counts.max()) - 1).bit_length())
             routed = host_route(
@@ -292,11 +291,35 @@ class SnapshotStream:
 
     # ---- aggregations -------------------------------------------------------
 
-    def fold_neighbors(self, init_accum, fold_fn: Callable) -> OutputStream:
+    def fold_neighbors(
+        self, init_accum, fold_fn: Callable, mode: str = "device"
+    ) -> OutputStream:
         """Per key, fold neighbors in arrival order:
         fold_fn(accum, vid, nbr_id, edge_value) -> accum
         (reference EdgesFoldFunction, SnapshotStream.java:61-86).  Emits the
-        final accumulator per (vertex, window)."""
+        final accumulator per (vertex, window).
+
+        ``mode="host"`` runs ``fold_fn`` as plain Python per neighbor (the
+        EdgesFold escape hatch for non-traceable accumulators, e.g. string
+        building — same contract as ``apply_on_neighbors(mode="host")``);
+        ``init_accum`` may then be any Python value.
+        """
+        if mode not in ("device", "host"):
+            raise ValueError(f"unknown fold_neighbors mode {mode!r}")
+        if mode == "host":
+            import copy as _copy
+
+            def host_apply(vid, neighbors):
+                accum = _copy.deepcopy(init_accum)
+                for nbr, val in neighbors:
+                    accum = fold_fn(accum, vid, nbr, val)
+                # match the device path's record shape: tuple accumulators
+                # splat into multi-field records; anything else (including a
+                # LIST, which would otherwise hit the host-apply collector
+                # convention and emit each element separately) is one field
+                return accum if isinstance(accum, tuple) else (accum,)
+
+            return self._apply_on_neighbors_host(host_apply, None)
 
         def kernel(keys, nbrs, vals, valid):
             def per_key(key, nbr_row, val_row, valid_row):
@@ -326,11 +349,33 @@ class SnapshotStream:
 
         return OutputStream(records)
 
-    def reduce_on_edges(self, reduce_fn: Callable) -> OutputStream:
+    def reduce_on_edges(
+        self, reduce_fn: Callable, mode: str = "device"
+    ) -> OutputStream:
         """Per key, reduce edge values pairwise; emits (vertex, reduced)
         (reference EdgesReduceFunction + project(0,2), SnapshotStream.java:100-120).
         Edge values may be any pytree; valueless (NullValue) streams have
-        nothing to reduce and are rejected."""
+        nothing to reduce and are rejected.
+
+        ``mode="host"`` runs ``reduce_fn`` as plain Python (the EdgesReduce
+        escape hatch for non-traceable reducers), emitting the same
+        (vertex, reduced) records.
+        """
+        if mode not in ("device", "host"):
+            raise ValueError(f"unknown reduce_on_edges mode {mode!r}")
+        if mode == "host":
+
+            def host_apply(vid, neighbors):
+                if not neighbors:
+                    return None
+                if neighbors[0][1] is None:
+                    raise ValueError(_NEEDS_VALUES_MSG)
+                acc = neighbors[0][1]
+                for _, val in neighbors[1:]:
+                    acc = reduce_fn(acc, val)
+                return (vid, acc)
+
+            return self._apply_on_neighbors_host(host_apply, None)
 
         def kernel(keys, nbrs, vals, valid):
             def per_key(key, val_row, valid_row):

@@ -246,3 +246,104 @@ def test_apply_on_neighbors_host_collector_and_valueless():
         EdgeStream.from_arrays(src, dst, cfg).slice(
             1000, EdgeDirection.OUT
         ).apply_on_neighbors(wedges, mode="python")
+
+
+def test_fold_and_reduce_host_modes():
+    """EdgesFold/EdgesReduce escape hatches: plain-Python accumulators
+    (string building) and reducers through slice(), mirroring the
+    reference's arbitrary-Java contract (EdgesFold.java:47,
+    EdgesReduce.java:43)."""
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    stream = long_long_stream()
+    folded = sorted(
+        r[0]
+        for r in stream.slice(1000, EdgeDirection.OUT).fold_neighbors(
+            "", lambda acc, vid, nbr, val: acc + f"[{vid}->{nbr}:{val:g}]",
+            mode="host",
+        )
+    )
+    assert folded == [
+        "[1->2:12][1->3:13]",
+        "[2->3:23]",
+        "[3->4:34][3->5:35]",
+        "[4->5:45]",
+        "[5->1:51]",
+    ]
+
+    reduced = sorted(
+        tuple(r)
+        for r in long_long_stream()
+        .slice(1000, EdgeDirection.OUT)
+        .reduce_on_edges(lambda a, b: max(a, b), mode="host")
+    )
+    # device-path golden for comparison (same reduce, traceable form)
+    import jax.numpy as jnp
+
+    dev = sorted(
+        tuple(r)
+        for r in long_long_stream()
+        .slice(1000, EdgeDirection.OUT)
+        .reduce_on_edges(lambda a, b: jnp.maximum(a, b))
+    )
+    assert [(int(k), float(v)) for k, v in reduced] == [
+        (int(k), float(v)) for k, v in dev
+    ]
+
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown fold_neighbors mode"):
+        stream.slice(1000, EdgeDirection.OUT).fold_neighbors(
+            "", lambda *a: "", mode="python"
+        )
+
+
+def test_fold_neighbors_host_list_accumulator_is_one_record():
+    """A list-valued accumulator must emit as ONE record per vertex, not
+    splat through the host-apply collector convention (verify-drive
+    finding)."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    cfg = StreamConfig(vertex_capacity=16, batch_size=8)
+    s = EdgeStream.from_collection(
+        [(1, 2, 12.0), (1, 3, 13.0), (2, 3, 23.0)], cfg
+    )
+    out = sorted(
+        r[0]
+        for r in s.slice(1000, EdgeDirection.OUT).fold_neighbors(
+            [], lambda acc, vid, nbr, val: acc + [nbr], mode="host"
+        )
+    )
+    assert out == [[2, 3], [3]]
+
+
+def test_fold_neighbors_host_tuple_accumulator_matches_device_arity():
+    """Tuple accumulators splat into multi-field records in BOTH modes
+    (review finding: host mode must not change record arity)."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    dev = sorted(
+        tuple(map(float, r))
+        for r in long_long_stream()
+        .slice(1000, EdgeDirection.OUT)
+        .fold_neighbors(
+            (jnp.float32(0), jnp.float32(0)),
+            lambda acc, vid, nbr, val: (acc[0] + val, acc[1] + 1),
+        )
+    )
+    host = sorted(
+        tuple(map(float, r))
+        for r in long_long_stream()
+        .slice(1000, EdgeDirection.OUT)
+        .fold_neighbors(
+            (0.0, 0.0),
+            lambda acc, vid, nbr, val: (acc[0] + val, acc[1] + 1),
+            mode="host",
+        )
+    )
+    assert host == dev
+    assert all(len(r) == 2 for r in host)
