@@ -145,3 +145,40 @@ def test_whole_edge_distinct_fuzz_vs_python_set():
                 seen.add(e)
                 expect.append(e)
         assert got == expect, (trial, n, batch)
+
+
+def test_mesh_streaming_fold_empty_stream_emits_nothing():
+    """Zero-edge wire streams produce no emission on the mesh path, exactly
+    like the single-shard fast path."""
+    empty = np.empty((0,), np.int32)
+    for shards in (1, 8):
+        cfg = StreamConfig(vertex_capacity=32, batch_size=8, num_shards=shards)
+        out = (
+            EdgeStream.from_arrays(empty, empty, cfg)
+            .aggregate(ConnectedComponents())
+            .collect()
+        )
+        assert out == []
+    width = wire.width_for_capacity(32)
+    out = (
+        EdgeStream.from_wire(
+            [], 8, width, StreamConfig(vertex_capacity=32, batch_size=8, num_shards=8)
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert out == []
+
+
+def test_mesh_streaming_fold_fewer_edges_than_shards():
+    """A 3-edge stream over 8 shards pads empty rows and still folds."""
+    src = np.array([1, 2, 5], np.int32)
+    dst = np.array([2, 3, 6], np.int32)
+    cfg = StreamConfig(vertex_capacity=32, batch_size=4, num_shards=8)
+    out = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    comps = out[-1][0].components()
+    assert sorted(map(sorted, comps.values())) == [[1, 2, 3], [5, 6]]
