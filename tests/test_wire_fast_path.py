@@ -206,6 +206,7 @@ def _spy_strategies(monkeypatch):
     calls = []
     orig_wire = agg_mod.SummaryAggregation._wire_records
     orig_mesh = agg_mod.MeshAggregationRunner.run
+    orig_mesh_wire = agg_mod.MeshAggregationRunner.wire_records
 
     def spy_wire(self, *a, **k):
         calls.append("wire")
@@ -215,8 +216,15 @@ def _spy_strategies(monkeypatch):
         calls.append("mesh")
         return orig_mesh(self, *a, **k)
 
+    def spy_mesh_wire(self, *a, **k):
+        calls.append("mesh-wire")
+        return orig_mesh_wire(self, *a, **k)
+
     monkeypatch.setattr(agg_mod.SummaryAggregation, "_wire_records", spy_wire)
     monkeypatch.setattr(agg_mod.MeshAggregationRunner, "run", spy_mesh)
+    monkeypatch.setattr(
+        agg_mod.MeshAggregationRunner, "wire_records", spy_mesh_wire
+    )
     return calls
 
 
@@ -235,10 +243,12 @@ def test_aggregate_strategy_selection_matrix(monkeypatch):
     assert calls == ["wire"]
 
     calls.clear()
+    # sharded wire-backed streams ride the sharded STREAMING fold (round 4:
+    # per-shard donated carries, no per-pane re-fold), not the pane runner
     EdgeStream.from_arrays(src, dst, sharded).aggregate(
         ConnectedComponents()
     ).collect()
-    assert calls == ["mesh"]
+    assert calls == ["mesh-wire"]
 
     calls.clear()
     import tempfile
@@ -254,6 +264,13 @@ def test_aggregate_strategy_selection_matrix(monkeypatch):
         list(zip(src.tolist(), dst.tolist())), single, 64
     ).aggregate(ConnectedComponents()).collect()
     assert calls == []  # simulated path: neither wire nor mesh
+
+    calls.clear()
+    # sharded NON-wire streams (collections) still use the pane runner
+    EdgeStream.from_collection(
+        list(zip(src.tolist(), dst.tolist())), sharded, 64
+    ).aggregate(ConnectedComponents()).collect()
+    assert calls == ["mesh"]
 
 
 def test_aggregate_strategy_selection_replay(monkeypatch):
@@ -286,4 +303,4 @@ def test_aggregate_strategy_selection_replay(monkeypatch):
     EdgeStream.from_wire(bufs, 64, 2, sharded, tail=tail).aggregate(
         ConnectedComponents()
     ).collect()
-    assert calls == ["mesh"]
+    assert calls == ["mesh-wire"]  # round 4: sharded streaming wire fold
