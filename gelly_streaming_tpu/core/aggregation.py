@@ -163,16 +163,38 @@ class SummaryAggregation:
             and not (cfg.ingest_window_edges or cfg.ingest_window_ms)
         )
 
+    def _wire_emit_every(self, cfg: StreamConfig, batch: int) -> int:
+        """Full batches per running emission on the wire fast path (0 = emit
+        only at stream end).
+
+        ``ingest_window_edges`` that divides the batch boundary keeps the
+        stream ON the fast path: the donated fold carry IS the running
+        merged summary (Merger semantics for non-transient descriptors), so
+        emitting ``transform(carry)`` every K/batch batches reproduces the
+        windowed path's running emission at full wire speed.  Non-aligned
+        or transient configurations fall back to the windowed runtime.
+        """
+        k = cfg.ingest_window_edges
+        if not k:
+            return 0
+        if k % batch or self.transient_state:
+            return -1  # not fast-path representable
+        return k // batch
+
     def _wire_eligible(self, stream) -> bool:
         cfg = stream.cfg
-        return (
-            (
-                getattr(stream, "_wire_arrays", None) is not None
-                or getattr(stream, "_wire_packed", None) is not None
-            )
-            and self._num_partitions(cfg) == 1
-            and not (cfg.ingest_window_edges or cfg.ingest_window_ms)
+        if (
+            getattr(stream, "_wire_arrays", None) is None
+            and getattr(stream, "_wire_packed", None) is None
+        ) or self._num_partitions(cfg) != 1:
+            return False
+        if cfg.ingest_window_ms:
+            return False  # wall-clock panes need the windowed time plane
+        packed = getattr(stream, "_wire_packed", None)
+        batch = (
+            packed[1] if packed is not None else stream._wire_arrays[2]
         )
+        return self._wire_emit_every(cfg, batch) >= 0
 
     def _wire_fused_step(self, stream, batch: int, width):
         """Jitted (stage-states, summary), wire-buffer -> carry step, cached so
@@ -464,6 +486,11 @@ class SummaryAggregation:
 
         every = cfg.wire_checkpoint_batches
         since_snap = 0
+        # running emission at batch boundaries (ingestion-time panes that
+        # stay on the fast path — see _wire_emit_every); recomputed against
+        # the EFFECTIVE batch: a stream shorter than one batch collapses to
+        # a single end-of-stream pane, which the final emission covers
+        emit_every = max(0, self._wire_emit_every(cfg, batch))
 
         def device_buffers():
             if packed is not None:
@@ -489,9 +516,22 @@ class SummaryAggregation:
                 for b, _ in pf:
                     yield b
 
+        pending_final = True
         try:
             for i, buf in enumerate(device_buffers()):
                 carry = fused(carry, buf)
+                absolute = start_batch + i + 1
+                if emit_every and absolute % emit_every == 0:
+                    # the donated carry IS the running merged summary
+                    # (Merger semantics): emit the pane's running record
+                    # without leaving the fast path.  CLONE first — the next
+                    # fused call donates the carry's buffers, which would
+                    # delete them out from under the emitted record
+                    out = self.transform(_tree_copy(carry[1]))
+                    yield out if isinstance(out, tuple) else (out,)
+                    # a stream ending exactly on a pane boundary with no
+                    # tail has nothing further to emit
+                    pending_final = absolute != n_full or tail_pair is not None
                 since_snap += 1
                 if checkpoint_path and every and since_snap >= every:
                     # the snapshot clones the carry on device BEFORE the next
@@ -514,11 +554,12 @@ class SummaryAggregation:
                 )
             if total_edges == 0:
                 return
-            out = self.transform(carry[1])
-            # emit BEFORE the final snapshot: a crash between the two
-            # re-emits on recovery (at-least-once) instead of dropping the
-            # record
-            yield out if isinstance(out, tuple) else (out,)
+            if pending_final:
+                out = self.transform(carry[1])
+                # emit BEFORE the final snapshot: a crash between the two
+                # re-emits on recovery (at-least-once) instead of dropping
+                # the record
+                yield out if isinstance(out, tuple) else (out,)
             if checkpoint_path:
                 snapshot(n_full, True, carry)
         except BaseException:
