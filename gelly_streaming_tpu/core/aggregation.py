@@ -1118,27 +1118,150 @@ class MeshAggregationRunner:
 
         return row, n_rows, row_len, width, total
 
-    def _wire_mesh_checkpoint_like(self, stream, row_len: int):
+    def _wire_mesh_checkpoint_like(
+        self, stream, row_len: int, rows: Optional[int] = None
+    ):
+        """Snapshot layout; ``rows`` overrides the leading axis (the number
+        of shard rows held: S for single-process saves, this process's
+        addressable count for per-process saves)."""
         cfg = stream.cfg
-        S = self.num_shards
+        n = self.num_shards if rows is None else rows
 
         def stack(tree):
             return jax.tree.map(
                 lambda a: np.broadcast_to(
-                    np.asarray(a), (S,) + np.shape(np.asarray(a))
+                    np.asarray(a), (n,) + np.shape(np.asarray(a))
                 ).copy(),
                 tree,
             )
 
-        return {
+        like = {
             "summary": stack(self.agg.initial_state(cfg)),
             "stages": stack(tuple(st.init(cfg) for st in stream._stages)),
-            "touched": np.zeros((S,), bool),
+            "touched": np.zeros((n,), bool),
             "next_group": np.zeros((), np.int64),
             "row_len": np.zeros((), np.int64),
             "shards": np.zeros((), np.int64),
             "done": np.zeros((), bool),
         }
+        if rows is not None:
+            like["rows"] = np.zeros((n,), np.int64)
+        return like
+
+    def _local_rows(self):
+        """Shard rows this process addresses (row r lives on device r)."""
+        return sorted(
+            r
+            for r, d in enumerate(self.mesh.devices.flat)
+            if d.process_index == jax.process_index()
+        )
+
+    def _wire_mesh_restore_per_process(
+        self, stream, checkpoint_path: str, row_len: int, sharding
+    ):
+        """Per-process restore for multi-process meshes.
+
+        Each process loads only its own file; validity, stream position, and
+        row ownership must AGREE across processes (one process_allgather
+        round) or all start fresh together — a split restore would deadlock
+        the collective finish.  Returns (carry | None, start_group, done).
+        """
+        from jax.experimental import multihost_utils
+
+        from gelly_streaming_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_state,
+            per_process_file,
+        )
+
+        rows = self._local_rows()
+        k = len(rows)
+        path = per_process_file(checkpoint_path)
+        snap = None
+        if checkpoint_exists(path):
+            like = self._wire_mesh_checkpoint_like(stream, row_len, rows=k)
+            try:
+                snap = load_state(path, like)
+            except ValueError:
+                snap = None
+        if snap is not None and (
+            int(snap["row_len"]) != row_len
+            or int(snap["shards"]) != self.num_shards
+        ):
+            # same loud failure as the single-process branch: a changed
+            # batch/shard geometry would misalign the stream position, and
+            # silently re-folding from group 0 would discard the
+            # checkpointed progress with no signal.  Every process computes
+            # this from its own file + static config, so all raise together.
+            raise ValueError(
+                f"mesh wire checkpoint was written at row_len "
+                f"{int(snap['row_len'])} x {int(snap['shards'])} shards; "
+                f"resuming with {row_len} x {self.num_shards} would "
+                "misalign the stream position"
+            )
+        ok = (
+            snap is not None
+            and [int(r) for r in snap["rows"]] == rows
+        )
+        pos = int(snap["next_group"]) if ok else -1
+        done = bool(snap["done"]) if ok else False
+        agree = multihost_utils.process_allgather(
+            np.array([int(ok), pos, int(done)], np.int64)
+        )
+        if not (
+            agree[:, 0].all()
+            and (agree[:, 1] == agree[0, 1]).all()
+            and (agree[:, 2] == agree[0, 2]).all()
+        ):
+            return None, 0, False
+        row_to_i = {r: i for i, r in enumerate(rows)}
+        S = self.num_shards
+
+        def build(local):
+            def cb(index):
+                r = int(index[0].start or 0)
+                return local[row_to_i[r]][None]
+
+            return jax.make_array_from_callback(
+                (S,) + local.shape[1:], sharding, cb
+            )
+
+        carry = jax.tree.map(
+            build, (snap["stages"], snap["summary"], snap["touched"])
+        )
+        return carry, int(agree[0, 1]), bool(agree[0, 2])
+
+    def _wire_mesh_save_per_process(
+        self, checkpoint_path: str, carry, pos: int, done: bool, row_len: int
+    ) -> None:
+        """Each process saves ONLY its addressable shard rows of the carry."""
+        from gelly_streaming_tpu.utils.checkpoint import (
+            per_process_file,
+            save_state,
+        )
+
+        rows = self._local_rows()
+
+        def local(leaf):
+            shards = sorted(
+                leaf.addressable_shards, key=lambda s: s.index[0].start
+            )
+            return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+        host = jax.tree.map(local, carry)
+        save_state(
+            per_process_file(checkpoint_path),
+            {
+                "summary": host[1],
+                "stages": host[0],
+                "touched": host[2],
+                "rows": np.array(rows, np.int64),
+                "next_group": np.full((), pos, np.int64),
+                "row_len": np.full((), row_len, np.int64),
+                "shards": np.full((), self.num_shards, np.int64),
+                "done": np.full((), done, bool),
+            },
+        )
 
     def wire_records(
         self,
@@ -1153,11 +1276,14 @@ class MeshAggregationRunner:
         donated per-shard carries — the stream is folded ONCE, batch by
         batch, exactly like the single-chip wire fast path; the only
         cross-shard communication is the collective merge at stream end.
-        Positional checkpoints snapshot the whole [S, ...] carry plus the
-        group position every ``cfg.wire_checkpoint_batches`` rows
-        (synchronously — the gather is one [S,...] download per interval);
-        single-process meshes only (a multi-process mesh has non-addressable
-        shards and needs per-process saves).
+        Positional checkpoints snapshot the carry plus the group position
+        every ``cfg.wire_checkpoint_batches`` rows (synchronously — the
+        download is one carry per interval).  Single-process meshes save
+        the whole [S, ...] carry to one file; MULTI-PROCESS meshes save per
+        process — each host writes only its addressable shard rows
+        (`utils.checkpoint.per_process_file`), and restore requires every
+        host to agree on validity, position, and row ownership (one
+        process_allgather round) or all start fresh together.
         """
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
@@ -1172,21 +1298,32 @@ class MeshAggregationRunner:
         cfg = stream.cfg
         agg = self.agg
         S = self.num_shards
-        if checkpoint_path and jax.process_count() > 1:
-            raise NotImplementedError(
-                "mesh wire checkpointing gathers the carry to one process; "
-                "multi-process meshes need a per-process snapshot"
-            )
+        multi = jax.process_count() > 1
         row, n_rows, row_len, width, total_edges = self._wire_mesh_plan(stream)
         n_groups = -(-n_rows // S) if n_rows else 0
         step, finish = self._wire_stream_fns(
             cfg, stream._stages, row_len, width
         )
 
+        sharding = NamedSharding(self.mesh, P(self._axis))
         start_group = 0
+        carry = None
         carry_host = None
         like = None
-        if checkpoint_path and restore and checkpoint_exists(checkpoint_path):
+        if checkpoint_path and restore and multi:
+            restored, start_group, was_done = (
+                self._wire_mesh_restore_per_process(
+                    stream, checkpoint_path, row_len, sharding
+                )
+            )
+            if was_done and restored is not None:
+                # stream fully folded before the crash: re-run only the
+                # collective finish and re-emit (at-least-once)
+                out = agg.transform(finish(restored))
+                yield out if isinstance(out, tuple) else (out,)
+                return
+            carry = restored
+        elif checkpoint_path and restore and checkpoint_exists(checkpoint_path):
             like = self._wire_mesh_checkpoint_like(stream, row_len)
             try:
                 snap = load_state(checkpoint_path, like)
@@ -1209,11 +1346,11 @@ class MeshAggregationRunner:
                 start_group = int(snap["next_group"])
                 carry_host = (snap["stages"], snap["summary"], snap["touched"])
 
-        sharding = NamedSharding(self.mesh, P(self._axis))
-        if carry_host is None:
-            like = like or self._wire_mesh_checkpoint_like(stream, row_len)
-            carry_host = (like["stages"], like["summary"], like["touched"])
-        carry = jax.device_put(carry_host, sharding)
+        if carry is None:
+            if carry_host is None:
+                like = like or self._wire_mesh_checkpoint_like(stream, row_len)
+                carry_host = (like["stages"], like["summary"], like["touched"])
+            carry = jax.device_put(carry_host, sharding)
 
         every_groups = (
             max(1, cfg.wire_checkpoint_batches // S)
@@ -1222,6 +1359,11 @@ class MeshAggregationRunner:
         )
 
         def save(pos: int, done: bool, carry_now):
+            if multi:
+                self._wire_mesh_save_per_process(
+                    checkpoint_path, carry_now, pos, done, row_len
+                )
+                return
             host = jax.tree.map(np.asarray, carry_now)
             save_state(
                 checkpoint_path,

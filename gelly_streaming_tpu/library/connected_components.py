@@ -446,12 +446,9 @@ class BlockShardedCC:
 
     @staticmethod
     def _proc_file(checkpoint_path: str) -> str:
-        base = (
-            checkpoint_path[: -len(".npz")]
-            if checkpoint_path.endswith(".npz")
-            else checkpoint_path
-        )
-        return f"{base}.proc{jax.process_index()}.npz"
+        from gelly_streaming_tpu.utils.checkpoint import per_process_file
+
+        return per_process_file(checkpoint_path)
 
     def _save_per_process(
         self, checkpoint_path: str, label, start_after: int, global_done: bool

@@ -78,3 +78,14 @@ def _treedef_token(state: Any):
         "shapes": [list(np.shape(l)) for l in leaves],
         "dtypes": [str(np.asarray(l).dtype) for l in leaves],
     }
+
+
+def per_process_file(path: str) -> str:
+    """Per-process snapshot file name for multi-process sharded saves.
+
+    Each host writes only its ADDRESSABLE shard rows (the orbax-style
+    per-host save); the suffix keys the process index, normalized so the
+    .npz extension stays terminal.
+    """
+    base = path[: -len(".npz")] if path.endswith(".npz") else path
+    return f"{base}.proc{jax.process_index()}.npz"
