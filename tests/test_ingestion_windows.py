@@ -307,3 +307,34 @@ def test_ingest_panes_wire_fast_path_exact_boundary(monkeypatch):
         .collect()
     )
     assert len(out) == 2  # 128 edges, 64/pane, boundary-exact
+
+
+def test_ingest_panes_fast_path_covers_replay_source():
+    """from_wire replay streams with batch-aligned panes also stay on the
+    fast path with running emission (eligibility reads the packed batch)."""
+    from gelly_streaming_tpu.io import wire
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+
+    rng = np.random.default_rng(29)
+    src = rng.integers(0, 64, 256).astype(np.int32)
+    dst = rng.integers(0, 64, 256).astype(np.int32)
+    width = wire.width_for_capacity(64)
+    bufs, tail = wire.pack_stream(src, dst, 32, width)
+    assert tail is None
+    cfg = StreamConfig(vertex_capacity=64, batch_size=32, ingest_window_edges=64)
+    agg = ConnectedComponents()
+    stream = EdgeStream.from_wire(bufs, 32, width, cfg)
+    assert agg._wire_eligible(stream)
+    out = stream.aggregate(agg).collect()
+    assert len(out) == 4  # 256 edges at 64/pane, boundary-exact
+    # final pane equals the plain single-emission run
+    plain = (
+        EdgeStream.from_wire(
+            bufs, 32, width, StreamConfig(vertex_capacity=64, batch_size=32)
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert out[-1][0].components() == plain[-1][0].components()
