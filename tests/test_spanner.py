@@ -87,3 +87,64 @@ def test_spanner_k2_matches_sequential_reference():
         if not ref.bounded_bfs(u, v, 2):
             ref.add_edge(u, v)
     assert got == ref.edges()
+
+
+def test_within_k_balls_matches_bounded_bfs():
+    """Exact meet-in-the-middle balls == dense BFS for k=1..4 on random
+    tables (the general-k capacity-independent admission body)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gelly_streaming_tpu.summaries import adjacency
+
+    rng = np.random.default_rng(6)
+    nbrs, deg = adjacency.init_table(48, 6)
+    for _ in range(40):
+        u, v = rng.integers(0, 48, 2)
+        nbrs, deg = adjacency.add_undirected_edge(
+            nbrs, deg, jnp.int32(u), jnp.int32(v)
+        )
+    balls = jax.jit(adjacency.within_k_balls, static_argnames="k")
+    bfs = jax.jit(adjacency.bounded_bfs, static_argnames="k")
+    for k in (1, 2, 3, 4):
+        for _ in range(80):
+            a, b = (int(x) for x in rng.integers(0, 48, 2))
+            got = bool(balls(nbrs, jnp.int32(a), jnp.int32(b), k=k))
+            want = bool(bfs(nbrs, jnp.int32(a), jnp.int32(b), k=k))
+            assert got == want, (k, a, b, got, want)
+
+
+def test_spanner_k3_ball_body_matches_bfs_body(monkeypatch):
+    """Force the ball body on a k=3 spanner and compare the admitted edge
+    set against the BFS body on the same stream."""
+    import numpy as np
+
+    import gelly_streaming_tpu.library.spanner as spanner_mod
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.spanner import Spanner
+
+    rng = np.random.default_rng(12)
+    src = rng.integers(0, 40, 300).astype(np.int32)
+    dst = rng.integers(0, 40, 300).astype(np.int32)
+    cfg = StreamConfig(vertex_capacity=64, batch_size=64, max_degree=32)
+
+    def run(force_balls):
+        if force_balls:
+            monkeypatch.setattr(
+                spanner_mod.adjacency, "ball_cost", lambda d, k: 0
+            )
+        else:
+            monkeypatch.setattr(
+                spanner_mod.adjacency,
+                "ball_cost",
+                lambda d, k: 1 << 60,
+            )
+        agg = Spanner(1000, k=3)
+        out = (
+            EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
+        )
+        return out[-1][0].edges()
+
+    assert run(True) == run(False)
