@@ -305,6 +305,53 @@ def _cpu_baseline(src, dst, capacity: int, trials: int, sample: int):
     return statistics.median(cpu_trials), cpu_trials
 
 
+def _flink_proxy(src, dst, capacity: int, trials: int, sample: int):
+    """Measured Flink-shaped record-at-a-time baseline (VERDICT r4 item 2).
+
+    The pinned ``cpu_baseline_eps`` is a deliberately strong array union-find
+    with none of the costs the reference actually pays per record.  This
+    measures those costs in this image: per-record Tuple2 big-endian
+    serialization + key-group selection, a kernel AF_UNIX socketpair shuffle
+    hop in 32 KiB network buffers, record-at-a-time deserialization, and a
+    HashMap-backed DisjointSet fold (native/edge_parser.cpp flink_proxy_cc —
+    optimized C++, so still an UPPER bound on the JVM stack it mimics:
+    pom.xml:38-63 provided runtime, SimpleEdgeStream.java:461-478,
+    DisjointSet.java:92-118).  Labels are cross-checked against cc_baseline's
+    on the same sample.  Runs pre-device like the pinned denominator.
+    """
+    from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+    lib = load_ingest_lib()
+    if lib is None or not hasattr(lib, "flink_proxy_cc"):
+        return None, [], None
+    proxy_trials = []
+    labels = np.empty(capacity, np.int32)
+    for _ in range(trials):
+        ns = lib.flink_proxy_cc(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            sample,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            capacity,
+        )
+        if ns <= 0:
+            return None, [], None
+        proxy_trials.append(sample / (ns / 1e9))
+    parent = np.arange(capacity, dtype=np.int32)
+    lib.cc_baseline(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        sample,
+        parent.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        capacity,
+    )
+    return (
+        statistics.median(proxy_trials),
+        proxy_trials,
+        bool(np.array_equal(labels, parent)),
+    )
+
+
 def main():
     num_edges = int(os.environ.get("GELLY_BENCH_EDGES", 50 << 21))
     capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
@@ -336,6 +383,22 @@ def main():
             f"cpu trials (edges/s, pre-device, sample {cpu_sample >> 20}M): "
             f"{[round(t / 1e6, 1) for t in cpu_trials]}M "
             f"spread {_PARTIAL['cpu_spread']}",
+            file=sys.stderr,
+        )
+
+    # ---- measured Flink-shaped record-at-a-time baseline (also pre-device) --
+    proxy_sample = min(num_edges, 2 << 20)
+    proxy_eps, proxy_trials, proxy_labels_ok = _flink_proxy(
+        src, dst, capacity, max(1, cpu_trials_n - 2), proxy_sample
+    )
+    if proxy_eps:
+        _PARTIAL["flink_proxy_eps"] = round(proxy_eps, 1)
+        _PARTIAL["flink_proxy_trials"] = [round(t, 1) for t in proxy_trials]
+        _PARTIAL["flink_proxy_labels_ok"] = proxy_labels_ok
+        print(
+            f"flink proxy trials (edges/s, sample {proxy_sample >> 20}M): "
+            f"{[round(t / 1e6, 2) for t in proxy_trials]}M "
+            f"labels_ok={proxy_labels_ok}",
             file=sys.stderr,
         )
 
@@ -564,6 +627,7 @@ def main():
 
     # ---- secondary: everything-on-one-host (pack inside the timed loop) ----
     e2e_eps = None
+    e2e_breakdown = None
     try:
         if time_left() < 90:
             raise RuntimeError("deadline budget exhausted")
@@ -575,10 +639,40 @@ def main():
         t0 = time.perf_counter()
         r2 = e2e_out.collect()
         jax.block_until_ready((r2[-1][0].parent,))
-        e2e_eps = n2 / (time.perf_counter() - t0)
+        e2e_wall = time.perf_counter() - t0
+        e2e_eps = n2 / e2e_wall
         _PARTIAL["e2e_eps"] = round(e2e_eps, 1)
+        # decomposition (VERDICT r4 item 5): time each term of the in-loop
+        # pipeline ALONE on the same edges — host pack, host->device
+        # transfer, device fold (the last from the measured device_eps
+        # roofline; same fused step, resident buffer).  On this 1-core host
+        # pack competes with transfer for CPU, so the terms mostly ADD; on a
+        # multi-core PCIe host pack pipelines behind transfer and e2e
+        # approaches the transfer bound.  overlap_ratio = sum(terms)/wall:
+        # ~1 means fully serialized (the single-core roofline), >1 means the
+        # pipeline recovered some overlap.
+        t0 = time.perf_counter()
+        b2, _ = wire.pack_stream(src[:n2], dst[:n2], batch, width)
+        pack_s = time.perf_counter() - t0
+        _settle_link(0.9, min(settle_max, 60.0))
+        t0 = time.perf_counter()
+        jax.block_until_ready([jax.device_put(b) for b in b2])
+        transfer_s = time.perf_counter() - t0
+        fold_s = n2 / device_eps if device_eps else None
+        e2e_breakdown = {
+            "e2e_wall_s": round(e2e_wall, 3),
+            "e2e_pack_s": round(pack_s, 3),
+            "e2e_transfer_s": round(transfer_s, 3),
+            "e2e_fold_s": round(fold_s, 4) if fold_s else None,
+            "e2e_overlap_ratio": round(
+                (pack_s + transfer_s + (fold_s or 0.0)) / e2e_wall, 2
+            ),
+        }
+        _PARTIAL.update(e2e_breakdown)
         print(
-            f"e2e (pack in loop, {n2 >> 20}M edges): {e2e_eps / 1e6:.1f}M eps",
+            f"e2e (pack in loop, {n2 >> 20}M edges): {e2e_eps / 1e6:.1f}M eps"
+            f" — pack {pack_s:.2f}s + transfer {transfer_s:.2f}s + fold "
+            f"{(fold_s or 0.0) * 1e3:.1f}ms vs wall {e2e_wall:.2f}s",
             file=sys.stderr,
         )
     except Exception as e:  # never fail the headline metric on the extra one
@@ -627,17 +721,26 @@ def main():
                 "wire_bytes_per_edge": round(bpe, 3),
                 "cpu_baseline_eps": round(cpu_eps, 1) if cpu_eps else None,
                 # the denominator is a deliberately STRONG stand-in: a native
-                # single-core union-find with no serialization/shuffle —
-                # published Flink per-core keyed-op throughputs are ~1-5M
-                # records/s (BASELINE.md), so vs_baseline understates the
-                # framework's edge over the actual reference stack by ~10-20x.
+                # single-core union-find with no serialization/shuffle.
+                # flink_proxy_eps below MEASURES the reference's real
+                # per-record cost structure in this image (serialize + socket
+                # shuffle + HashMap state; still optimized C++, so an upper
+                # bound on the JVM stack) — vs_flink_proxy grounds the
+                # "vs Flink" multiple in a number, not a citation.
                 # Round 3's 45M-eps denominator was contention-depressed
                 # (measured after device phases on the 1-core host); the
                 # pinned pre-device measurement reads ~90M on an idle host.
-                "baseline_note": "native 1-core union-find proxy, ~10-20x "
-                "stronger than JVM/Flink per-record folds (published Flink "
-                "keyed-op throughput ~1-5M rec/s); pinned pre-device, see "
-                "cpu_trials/cpu_spread",
+                "baseline_note": "cpu_baseline_eps = native 1-core union-find "
+                "(strong proxy); flink_proxy_eps = measured record-at-a-time "
+                "Flink-shaped stack (Tuple2 serialize + socketpair shuffle + "
+                "HashMap DisjointSet, C++ upper bound on the JVM original); "
+                "both pinned pre-device",
+                "flink_proxy_eps": round(proxy_eps, 1) if proxy_eps else None,
+                "flink_proxy_trials": [round(t, 1) for t in proxy_trials],
+                "flink_proxy_labels_ok": proxy_labels_ok,
+                "vs_flink_proxy": round(tpu_eps / proxy_eps, 1)
+                if proxy_eps
+                else None,
                 "cpu_trials": [round(t, 1) for t in cpu_trials],
                 "cpu_spread": round(min(cpu_trials) / max(cpu_trials), 3)
                 if cpu_trials
@@ -645,6 +748,7 @@ def main():
                 "pack_eps": round(pack_eps, 1),
                 "ckpt_eps": round(ckpt_eps, 1) if ckpt_eps else None,
                 "e2e_eps": round(e2e_eps, 1) if e2e_eps else None,
+                **(e2e_breakdown or {}),
                 "device_eps": round(device_eps, 1) if device_eps else None,
                 "device_wire_gbps": round(device_eps * bpe / 1e9, 1)
                 if device_eps

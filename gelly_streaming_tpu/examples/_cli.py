@@ -9,8 +9,31 @@ a built-in default dataset when run bare.
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import List, Optional, Tuple
+
+
+def _honor_platform_env() -> None:
+    """Pin the jax platform from ``JAX_PLATFORMS`` via ``jax.config``.
+
+    With an out-of-tree PJRT plugin on the path (the session's tunneled TPU),
+    the env var alone does not stop the plugin from probing its device at
+    backend init — a CLI asked to run on CPU would hang whenever the tunnel
+    is down.  The config update (applied before any device use, as in
+    tests/conftest.py) does.  No-op when the env var is unset.
+    """
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plats)
+        except Exception:
+            pass  # backend already initialized: keep whatever it picked
+
+
+_honor_platform_env()
 
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream

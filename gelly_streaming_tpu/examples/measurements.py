@@ -14,6 +14,7 @@ and prints ONE JSON line of metrics.
   python -m gelly_streaming_tpu.examples.measurements triangles     [options]
   python -m gelly_streaming_tpu.examples.measurements spanner       [options]
   python -m gelly_streaming_tpu.examples.measurements matching      [options]
+  python -m gelly_streaming_tpu.examples.measurements sage          [options]
 
 Options: --edges N --vertices C --batch B --seed S; triangles also takes
 --windows W --pane-vertices K (panes are K-vertex random graphs counted with
@@ -74,11 +75,59 @@ def measure_degrees(args) -> dict:
         lambda: jnp.zeros((args.vertices,), jnp.int32),
     )
     total = int(np.asarray(counts).sum())
-    return {
+    out = {
         "workload": "degrees",
         "edges_per_sec": round(eps, 1),
         "edges_folded": folded,
         "degree_total": total,
+    }
+    if getattr(args, "trace", False):
+        out.update(_measure_degree_trace(args))
+    return out
+
+
+def _measure_degree_trace(args) -> dict:
+    """Running-trace EMISSION plane (VERDICT r4 item 6): the full
+    (vertex, degree) record trace — 2 records per edge — through
+    ``get_degrees()`` with the pipelined device->host download path
+    (io/wire.prefetch_to_host overlapping ``copy_to_host_async`` with later
+    batches' compute).  Reports records/s and the downloaded GB/s; on a
+    narrow link the steady state should sit at min(downlink, host decode),
+    not the serialized per-batch round-trip sum the pre-pipelined path paid
+    (SimpleEdgeStream.java:461-478 is the running-trace contract)."""
+    import time
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.io import wire
+
+    rng = np.random.default_rng(args.seed)
+    n = args.edges - args.edges % args.batch
+    src = rng.integers(0, args.vertices, n).astype(np.int32)
+    dst = rng.integers(0, args.vertices, n).astype(np.int32)
+    cfg = StreamConfig(vertex_capacity=args.vertices, batch_size=args.batch)
+    width = wire.width_for_capacity(args.vertices)
+    bufs, _ = wire.pack_stream(src, dst, args.batch, width)
+
+    def drain():
+        records = nbytes = 0
+        stream = EdgeStream.from_wire(bufs, args.batch, width, cfg)
+        for block in stream.get_degrees().blocks():
+            records += len(block.columns[0])
+            nbytes += sum(
+                c.nbytes if hasattr(c, "nbytes") else 0
+                for c in block.columns
+            )
+        return records, nbytes
+
+    drain()  # compile + warm the transfer path
+    t0 = time.perf_counter()
+    records, nbytes = drain()
+    dt = time.perf_counter() - t0
+    return {
+        "trace_records": records,
+        "trace_records_per_sec": round(records / dt, 1),
+        "trace_host_gbps": round(nbytes / dt / 1e9, 5),
     }
 
 
@@ -166,6 +215,8 @@ def measure_spanner(args) -> dict:
     from gelly_streaming_tpu.core.stream import EdgeStream
     from gelly_streaming_tpu.library.spanner import Spanner
 
+    from gelly_streaming_tpu.summaries import adjacency
+
     rng = np.random.default_rng(args.seed)
     src = rng.integers(0, args.vertices, args.edges).astype(np.int32)
     dst = rng.integers(0, args.vertices, args.edges).astype(np.int32)
@@ -174,25 +225,77 @@ def measure_spanner(args) -> dict:
         max_degree=args.max_degree,
         batch_size=args.batch,
     )
-    agg = Spanner(window_ms=1000, k=args.k)
 
-    def run():
-        out = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
-        final = out[-1][0]
-        jax.block_until_ready((final.nbrs, final.deg))
-        return final
+    def timed(body):
+        agg = Spanner(window_ms=1000, k=args.k, body=body)
 
-    run()  # compile warmup (first pane compiles filter + admission loop)
-    t0 = time.perf_counter()
-    final = run()
-    dt = time.perf_counter() - t0
-    spanner_edges = int((np.asarray(final.nbrs) >= 0).sum()) // 2
+        def run():
+            out = (
+                EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
+            )
+            final = out[-1][0]
+            jax.block_until_ready((final.nbrs, final.deg))
+            return final
+
+        run()  # compile warmup (first pane compiles filter + admission loop)
+        t0 = time.perf_counter()
+        final = run()
+        dt = time.perf_counter() - t0
+        return final, args.edges / dt
+
+    from gelly_streaming_tpu.library.spanner import auto_body
+
+    # the analytical crossover's pick for this (k, C, D) — the SAME helper
+    # body="auto" executes (library/spanner.py), so calibration cannot
+    # drift from production
+    analytical_pick = auto_body(args.vertices, args.max_degree, args.k)
+    if args.body != "both":
+        final, eps = timed(args.body)
+        out = {
+            "workload": "spanner",
+            "k": args.k,
+            "body": args.body,
+            "edges_per_sec": round(eps, 1),
+            "edges_streamed": args.edges,
+            "spanner_edges": int((np.asarray(final.nbrs) >= 0).sum()) // 2,
+        }
+        if args.body == "auto":
+            out["auto_picked"] = analytical_pick
+        return out
+    # calibration mode (VERDICT r4 item 7): run BOTH exact bodies on the
+    # same stream, verify they admit the identical spanner, and check the
+    # ball_cost crossover picks the winner.  At k=2 auto runs within_two,
+    # not either calibrated body — the crossover is not consulted there, so
+    # crossover_correct is null rather than judging a pick auto never makes.
+    final_balls, eps_balls = timed("balls")
+    final_bfs, eps_bfs = timed("bfs")
+    edges_balls = int((np.asarray(final_balls.nbrs) >= 0).sum()) // 2
+    edges_bfs = int((np.asarray(final_bfs.nbrs) >= 0).sum()) // 2
+    measured_winner = "balls" if eps_balls >= eps_bfs else "bfs"
     return {
-        "workload": "spanner",
+        "workload": "spanner_body_calibration",
         "k": args.k,
-        "edges_per_sec": round(args.edges / dt, 1),
+        "vertices": args.vertices,
+        "max_degree": args.max_degree,
         "edges_streamed": args.edges,
-        "spanner_edges": spanner_edges,
+        "balls_eps": round(eps_balls, 1),
+        "bfs_eps": round(eps_bfs, 1),
+        "spanner_edges": edges_balls,
+        "bodies_agree": edges_balls == edges_bfs
+        and bool(
+            np.array_equal(
+                np.asarray(final_balls.deg), np.asarray(final_bfs.deg)
+            )
+        ),
+        "measured_winner": measured_winner,
+        "analytical_pick": analytical_pick,
+        "crossover_correct": (
+            measured_winner == analytical_pick
+            if analytical_pick in ("balls", "bfs")
+            else None
+        ),
+        "ball_cost": adjacency.ball_cost(args.max_degree, args.k),
+        "bfs_cost": args.k * args.vertices * args.max_degree,
     }
 
 
@@ -292,6 +395,108 @@ def measure_matching(args) -> dict:
     }
 
 
+def measure_sage(args) -> dict:
+    """1-layer GraphSAGE windowed message passing (BASELINE.md config row 5:
+    "applyOnNeighbors over sliced windows").  Per closed window the framework
+    builds degree-bucketed padded [K, D] neighborhoods, gathers [K, D, F]
+    feature rows, takes the masked mean and projects through two bf16 MXU
+    matmuls (library/graphsage.py sage_kernel).  Reports the end-to-end
+    window rate (edges/s and embeddings/s through the product API) and the
+    device-only pane latency + feature-gather bandwidth — the number
+    BASELINE.md row 5 lacked (VERDICT r4 item 4).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.core.types import EdgeDirection
+    from gelly_streaming_tpu.library.graphsage import (
+        GraphSAGEWindows,
+        init_params,
+        sage_kernel_jit,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    window_ms = 1000
+    per_w = max(1, args.edges // max(1, args.windows))
+    n = per_w * args.windows
+    src = rng.integers(0, args.vertices, n)
+    dst = rng.integers(0, args.vertices, n)
+    ts = np.repeat(np.arange(args.windows) * window_ms, per_w)
+    edges = [
+        (int(s), int(d), 0.0, int(t)) for s, d, t in zip(src, dst, ts)
+    ]
+    features = rng.normal(size=(args.vertices, args.features)).astype(
+        np.float32
+    )
+    params = init_params(
+        jax.random.PRNGKey(args.seed), args.features, args.out_features
+    )
+    cfg = StreamConfig(
+        vertex_capacity=args.vertices,
+        max_degree=args.max_degree,
+        batch_size=per_w,
+    )
+    sage = GraphSAGEWindows(params, features)
+
+    def run():
+        snapshot = EdgeStream.from_collection(
+            edges, cfg, batch_size=per_w, with_time=True
+        ).slice(window_ms, EdgeDirection.ALL)
+        total_keys = windows = 0
+        for keys, _ in sage.run(snapshot):
+            total_keys += len(keys)
+            windows += 1
+        return total_keys, windows
+
+    run()  # compile warmup (one compile per degree-bucket shape)
+    t0 = time.perf_counter()
+    total_keys, windows = run()
+    wall = time.perf_counter() - t0
+
+    # device-only pane latency + feature-gather volume on the same panes
+    snapshot = EdgeStream.from_collection(
+        edges, cfg, batch_size=per_w, with_time=True
+    ).slice(window_ms, EdgeDirection.ALL)
+    pane_ms: List[float] = []
+    feat_rows = 0
+    for hood in snapshot._neighborhood_panes():
+        k = jnp.asarray(hood.keys)
+        nb = jnp.asarray(hood.nbrs)
+        va = jnp.asarray(hood.valid)
+        jax.block_until_ready(
+            sage_kernel_jit(params, sage.features, k, nb, va)
+        )  # warm this shape
+        t1 = time.perf_counter()
+        jax.block_until_ready(
+            sage_kernel_jit(params, sage.features, k, nb, va)
+        )
+        pane_ms.append((time.perf_counter() - t1) * 1e3)
+        feat_rows += hood.keys.shape[0] * (1 + hood.nbrs.shape[1])
+    device_s = sum(pane_ms) / 1e3
+    return {
+        "workload": "graphsage",
+        "edges_per_sec": round(n / wall, 1),
+        "embeddings_per_sec": round(total_keys / wall, 1),
+        "windows": windows,
+        "features_in": args.features,
+        "features_out": args.out_features,
+        "device_p50_pane_ms": round(float(np.percentile(pane_ms, 50)), 3),
+        "device_p95_pane_ms": round(float(np.percentile(pane_ms, 95)), 3),
+        # gathered [K,(1+D),F] float32 rows per device-second: a lower bound
+        # on achieved HBM read bandwidth for the gather+mean stage
+        "feature_gather_gbps": round(
+            feat_rows * args.features * 4 / max(device_s, 1e-9) / 1e9, 3
+        ),
+        "feature_elements_per_sec": round(
+            feat_rows * args.features / max(device_s, 1e-9), 1
+        ),
+    }
+
+
 def measure_routing(args) -> dict:
     """Skew robustness of the device keyBy plane (SURVEY §7 "skewed keys"):
     route a zipf-keyed batch over the mesh with plain ``device_route`` vs
@@ -374,6 +579,9 @@ def measure_routing(args) -> dict:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    from gelly_streaming_tpu.examples._cli import _honor_platform_env
+
+    _honor_platform_env()
     p = argparse.ArgumentParser(prog="measurements", description=__doc__)
     sub = p.add_subparsers(dest="workload", required=True)
     for name in ("degrees", "bipartiteness"):
@@ -382,6 +590,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         sp.add_argument("--vertices", type=int, default=1 << 17)
         sp.add_argument("--batch", type=int, default=1 << 16)
         sp.add_argument("--seed", type=int, default=0)
+        if name == "degrees":
+            sp.add_argument(
+                "--trace", action="store_true",
+                help="also drain the full (vertex, degree) record trace "
+                "through the pipelined emission plane and report records/s",
+            )
     sp = sub.add_parser("triangles")
     sp.add_argument("--edges", type=int, default=1 << 17)
     sp.add_argument("--seed", type=int, default=0)
@@ -396,6 +610,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--batch", type=int, default=1 << 14)
     sp.add_argument("--max-degree", type=int, default=64)
     sp.add_argument("--k", type=int, default=2)
+    sp.add_argument(
+        "--body", choices=("auto", "balls", "bfs", "both"), default="auto",
+        help="per-candidate distance test; 'both' runs the calibration "
+        "(balls vs bfs on the same stream, crossover check)",
+    )
     sp.add_argument("--seed", type=int, default=0)
     sp = sub.add_parser("matching")
     sp.add_argument("--edges", type=int, default=1 << 16)
@@ -406,6 +625,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--edges", type=int, default=1 << 22)
     sp.add_argument("--vertices", type=int, default=1 << 20)
     sp.add_argument("--batch", type=int, default=1 << 20)
+    sp.add_argument("--seed", type=int, default=0)
+    sp = sub.add_parser("sage")
+    sp.add_argument("--edges", type=int, default=1 << 16)
+    sp.add_argument("--vertices", type=int, default=1 << 12)
+    sp.add_argument("--windows", type=int, default=8)
+    sp.add_argument("--features", type=int, default=128)
+    sp.add_argument("--out-features", type=int, default=128)
+    sp.add_argument("--max-degree", type=int, default=32)
     sp.add_argument("--seed", type=int, default=0)
     sp = sub.add_parser("routing")
     sp.add_argument("--shards", type=int, default=8)
@@ -426,6 +653,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "matching": measure_matching,
         "replay": measure_replay,
         "routing": measure_routing,
+        "sage": measure_sage,
     }[args.workload]
     print(json.dumps(fn(args)))
 

@@ -47,6 +47,19 @@ class SpannerState(NamedTuple):
 _balls = adjacency.expand_balls
 
 
+def auto_body(capacity: int, max_degree: int, k: int) -> str:
+    """The per-candidate distance body ``body="auto"`` runs for (k, C, D):
+    "within_two" (k=2 O(D^2) row intersection), "balls" (exact
+    meet-in-the-middle, cost independent of C), or "bfs" (dense k*C*D
+    sweep).  Single source of truth for the crossover — ``_admit_batch``
+    executes it and ``measurements spanner`` calibrates it."""
+    if k == 2:
+        return "within_two"
+    if adjacency.ball_cost(max_degree, k) < k * capacity * max_degree:
+        return "balls"
+    return "bfs"
+
+
 def _within_k_prefilter(nbrs, src, dst, k: int, cap: int, chunk: int = 256):
     """bool[B]: True only where dist(src, dst) <= k on ``nbrs`` for sure."""
     b = src.shape[0]
@@ -74,8 +87,16 @@ def _within_k_prefilter(nbrs, src, dst, k: int, cap: int, chunk: int = 256):
     return within[:b]
 
 
-def _admit_batch(nbrs, deg, src, dst, mask, k: int, cap: int):
-    """Two-phase spanner admission; returns the updated (nbrs, deg)."""
+def _admit_batch(nbrs, deg, src, dst, mask, k: int, cap: int,
+                 body_kind: str = "auto"):
+    """Two-phase spanner admission; returns the updated (nbrs, deg).
+
+    ``body_kind`` selects the per-candidate exact distance test: "auto"
+    picks by the analytical ``ball_cost`` crossover; "balls"/"bfs" force one
+    body (every body is exact, so the admitted spanner is identical — the
+    forced modes exist for the calibration measurement,
+    ``measurements spanner --body both``).
+    """
     b = src.shape[0]
     within_pre = _within_k_prefilter(nbrs, src, dst, k, cap)
     cand = mask & ~within_pre
@@ -90,23 +111,24 @@ def _admit_batch(nbrs, deg, src, dst, mask, k: int, cap: int):
         return carry[0] < m
 
     # per-candidate distance test: pick the cheapest EXACT form for this
-    # (k, C, D).  k=2 gets the O(D^2) row intersection; k>=3 uses exact
-    # meet-in-the-middle balls (cost independent of C) when their
-    # sort-based intersection beats the dense BFS's k*C*D sweep — the
-    # capacity-independence that lets the admission tail scale to
-    # reference-size graphs (VERDICT r3 weak #5)
+    # (k, C, D) via the shared ``auto_body`` crossover.  k=2 gets the O(D^2)
+    # row intersection; k>=3 uses exact meet-in-the-middle balls (cost
+    # independent of C) when their sort-based intersection beats the dense
+    # BFS's k*C*D sweep — the capacity-independence that lets the admission
+    # tail scale to reference-size graphs (VERDICT r3 weak #5)
     capacity, max_degree = nbrs.shape
-    use_balls = (
-        k != 2
-        and adjacency.ball_cost(max_degree, k) < k * capacity * max_degree
+    picked = (
+        auto_body(capacity, max_degree, k)
+        if body_kind == "auto"
+        else body_kind
     )
 
     def body(carry):
         i, nbrs, deg = carry
         u, v = cu[i], cv[i]
-        if k == 2:
+        if picked == "within_two":
             within = adjacency.within_two(nbrs, u, v)
-        elif use_balls:
+        elif picked == "balls":
             within = adjacency.within_k_balls(nbrs, u, v, k)
         else:
             within = adjacency.bounded_bfs(nbrs, u, v, k)
@@ -129,10 +151,14 @@ class Spanner(SummaryBulkAggregation):
     vertex plus its full neighbor row).
     """
 
-    def __init__(self, window_ms: int, k: int, filter_cap: int = 128):
+    def __init__(self, window_ms: int, k: int, filter_cap: int = 128,
+                 body: str = "auto"):
         super().__init__(window_ms)
+        if body not in ("auto", "balls", "bfs"):
+            raise ValueError(f"body must be auto/balls/bfs, got {body!r}")
         self.k = k
         self.filter_cap = filter_cap
+        self.body = body
 
     def initial_state(self, cfg: StreamConfig) -> SpannerState:
         nbrs, deg = adjacency.init_table(cfg.vertex_capacity, cfg.max_degree)
@@ -140,7 +166,8 @@ class Spanner(SummaryBulkAggregation):
 
     def update(self, state: SpannerState, src, dst, val, mask) -> SpannerState:
         nbrs, deg = _admit_batch(
-            state.nbrs, state.deg, src, dst, mask, self.k, self.filter_cap
+            state.nbrs, state.deg, src, dst, mask, self.k, self.filter_cap,
+            self.body,
         )
         return SpannerState(nbrs, deg)
 
@@ -159,7 +186,8 @@ class Spanner(SummaryBulkAggregation):
             ns = small.nbrs.reshape(-1)
             slot_ok = (ns >= 0) & (vs < ns)  # canonical: insert each edge once
             nbrs, deg = _admit_batch(
-                big.nbrs, big.deg, vs, jnp.maximum(ns, 0), slot_ok, k, cap
+                big.nbrs, big.deg, vs, jnp.maximum(ns, 0), slot_ok, k, cap,
+                self.body,
             )
             return SpannerState(nbrs, deg)
 

@@ -65,7 +65,9 @@ def _build() -> Optional[str]:
         so = os.path.join(d, "libgelly_ingest.so")
         if os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
             return so
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o"]
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o"
+    ]
     for d in _BUILD_DIRS:
         so = os.path.join(d, "libgelly_ingest.so")
         try:
@@ -143,6 +145,15 @@ def load_ingest_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.route_edges.restype = ctypes.c_int64
+        if hasattr(lib, "flink_proxy_cc"):
+            lib.flink_proxy_cc.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
+            lib.flink_proxy_cc.restype = ctypes.c_int64
         if hasattr(lib, "pack_edges_ef40"):
             lib.pack_edges_ef40.argtypes = [
                 ctypes.POINTER(ctypes.c_int32),

@@ -41,6 +41,21 @@ def test_degrees_small_edges_shrink_batch(capsys):
     assert out["degree_total"] == 200
 
 
+def test_degrees_trace_reports_emission_rate(capsys):
+    """--trace drains the full (vertex, degree) record trace through the
+    pipelined emission plane: exactly 2 records per edge, rate reported."""
+    out = _run(
+        [
+            "degrees", "--edges", "4096", "--vertices", "512",
+            "--batch", "1024", "--trace",
+        ],
+        capsys,
+    )
+    assert out["trace_records"] == 2 * 4096
+    assert out["trace_records_per_sec"] > 0
+    assert out["trace_host_gbps"] > 0
+
+
 def test_bipartiteness_random_dense_is_odd(capsys):
     out = _run(
         ["bipartiteness", "--edges", "4096", "--vertices", "64", "--batch", "512"],
@@ -92,6 +107,45 @@ def test_spanner_measurement(capsys):
     assert out["workload"] == "spanner"
     assert 0 < out["spanner_edges"] <= 2048
     assert out["edges_per_sec"] > 0
+
+
+def test_spanner_body_calibration(capsys):
+    """--body both runs BOTH exact distance bodies on the same stream
+    (VERDICT r4 item 7): identical spanners, both rates reported, and the
+    ball_cost crossover's pick recorded against the measured winner."""
+    from gelly_streaming_tpu.examples.measurements import main
+
+    main([
+        "spanner", "--edges", "2048", "--vertices", "128", "--batch", "512",
+        "--max-degree", "16", "--k", "3", "--body", "both",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["workload"] == "spanner_body_calibration"
+    assert out["bodies_agree"] is True
+    assert out["balls_eps"] > 0 and out["bfs_eps"] > 0
+    assert out["measured_winner"] in ("balls", "bfs")
+    assert out["analytical_pick"] in ("balls", "bfs")
+
+
+def test_sage_measurement(capsys):
+    out = _run(
+        [
+            "sage",
+            "--edges", "2048",
+            "--vertices", "256",
+            "--windows", "2",
+            "--features", "32",
+            "--out-features", "16",
+            "--max-degree", "8",
+        ],
+        capsys,
+    )
+    assert out["workload"] == "graphsage"
+    assert out["windows"] == 2
+    assert out["edges_per_sec"] > 0
+    assert out["embeddings_per_sec"] > 0
+    assert out["device_p50_pane_ms"] > 0
+    assert out["feature_gather_gbps"] > 0
 
 
 def test_replay_measurement(capsys):
