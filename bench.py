@@ -55,11 +55,15 @@ Prints ONE JSON line:
    "edges": ..., "chunks": [...], "chunk_gbps": [...], "waits_s": [...],
    "active_s": ..., "wall_s": ..., "wire_bytes_per_edge": ...,
    "cpu_baseline_eps": ..., "cpu_trials": [...], "cpu_spread": ...,
+   "flink_proxy_eps": ..., "vs_flink_proxy": ...,
    "pack_eps": ..., "ckpt_eps": ..., "e2e_eps": ...,
+   "e2e_pack_s": ..., "e2e_transfer_s": ..., "e2e_fold_s": ...,
+   "e2e_overlap_ratio": ...,
    "device_eps": ..., "device_wire_gbps": ..., "hbm_peak_gbps": ...,
    "hbm_util_lower_bound": ...,
    "triangle_p50_ms": ..., "triangle_p95_ms": ...,
-   "triangle_device_p50_ms": ..., "triangle_panes_per_sec": ...}
+   "triangle_device_p50_ms": ..., "triangle_panes_per_sec": ...,
+   "sage_device_p50_ms": ..., "sage_feature_gather_gbps": ...}
 
 device_eps is the device-only fold rate (unpack + union-find on a resident
 buffer) — the single-chip roofline (VERDICT r3 item 10): device_wire_gbps =
@@ -578,6 +582,62 @@ def main():
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"triangle latency skipped: {e}", file=sys.stderr)
 
+    # ---- BASELINE.md row 5: GraphSAGE MXU pane kernel ----------------------
+    # Device-only latency of the [K, D, F] masked neighbor mean + two bf16
+    # MXU projections on a representative pane (VERDICT r4 item 4: the one
+    # BASELINE workload that had no bench key).  Inputs stay resident (~8 MB
+    # features), so this stage costs the link almost nothing.
+    sage = {"sage_device_p50_ms": None, "sage_feature_gather_gbps": None}
+    try:
+        if os.environ.get("GELLY_BENCH_SAGE", "1") != "0":
+            from gelly_streaming_tpu.library.graphsage import (
+                init_params,
+                sage_kernel_jit,
+            )
+
+            K, D, F = 4096, 32, 128
+            s_rng = np.random.default_rng(9)
+            feats = jax.device_put(
+                s_rng.normal(size=(1 << 14, F)).astype(np.float32)
+            )
+            params = init_params(jax.random.PRNGKey(0), F, F)
+            keys_a = jax.device_put(
+                s_rng.integers(0, 1 << 14, K).astype(np.int32)
+            )
+            nbrs_a = jax.device_put(
+                s_rng.integers(0, 1 << 14, (K, D)).astype(np.int32)
+            )
+            valid_a = jax.device_put(
+                s_rng.random((K, D)) < 0.8
+            )
+            jax.block_until_ready(
+                sage_kernel_jit(params, feats, keys_a, nbrs_a, valid_a)
+            )  # compile
+            times = []
+            for _ in range(7):
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    sage_kernel_jit(params, feats, keys_a, nbrs_a, valid_a)
+                )
+                times.append((time.perf_counter() - t0) * 1e3)
+            p50 = float(np.percentile(times, 50))
+            sage = {
+                "sage_device_p50_ms": round(p50, 3),
+                # gathered [K,(1+D),F] f32 rows per device-second: HBM read
+                # lower bound of the gather+mean stage
+                "sage_feature_gather_gbps": round(
+                    K * (1 + D) * F * 4 / (p50 / 1e3) / 1e9, 2
+                ),
+            }
+            _PARTIAL.update(sage)
+            print(
+                f"sage pane [K={K},D={D},F={F}]: device p50 {p50:.2f} ms, "
+                f"gather >= {sage['sage_feature_gather_gbps']} GB/s",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"sage stage skipped: {e}", file=sys.stderr)
+
     def time_left() -> float:
         return deadline_s - (time.monotonic() - t_bench0)
 
@@ -660,9 +720,9 @@ def main():
         transfer_s = time.perf_counter() - t0
         fold_s = n2 / device_eps if device_eps else None
         e2e_breakdown = {
-            "e2e_wall_s": round(e2e_wall, 3),
-            "e2e_pack_s": round(pack_s, 3),
-            "e2e_transfer_s": round(transfer_s, 3),
+            "e2e_wall_s": round(e2e_wall, 4),
+            "e2e_pack_s": round(pack_s, 4),
+            "e2e_transfer_s": round(transfer_s, 4),
             "e2e_fold_s": round(fold_s, 4) if fold_s else None,
             "e2e_overlap_ratio": round(
                 (pack_s + transfer_s + (fold_s or 0.0)) / e2e_wall, 2
@@ -763,6 +823,7 @@ def main():
                     key: round(v, 2) if v is not None else None
                     for key, v in tri.items()
                 },
+                **sage,
             }
         )
     )
