@@ -85,7 +85,9 @@ GELLY_BENCH_BATCH (default 2^21 edges -> ~5.6 MB EF40 buffers),
 GELLY_BENCH_CHUNK_BUFS (buffers per timed chunk, default 5 -> ~28 MB),
 GELLY_BENCH_CPU_TRIALS (5), GELLY_BENCH_SETTLE_MAX (per-gate settle bound,
 default 120 s), GELLY_BENCH_WAIT_BUDGET (total settle seconds across the
-drive, default 300), GELLY_BENCH_E2E_EDGES (default 2M — sized so a post-headline refill covers it).
+drive, default 300), GELLY_BENCH_E2E_EDGES (default 4M — long enough that
+the link's ~40-65 ms result RTT no longer floors the rate, ~20 MB of pair40
+wire so a post-headline refill still covers it).
 """
 
 import ctypes
@@ -405,7 +407,12 @@ def main():
     cpu_trials_n = max(1, int(os.environ.get("GELLY_BENCH_CPU_TRIALS", 5)))
     settle_max = float(os.environ.get("GELLY_BENCH_SETTLE_MAX", 120.0))
     wait_budget = float(os.environ.get("GELLY_BENCH_WAIT_BUDGET", 300.0))
-    e2e_edges = int(os.environ.get("GELLY_BENCH_E2E_EDGES", 1 << 21))
+    # 4M edges: at the healthy-link e2e rate the timed span is ~100ms+, so
+    # the ~40-65ms result-delivery RTT no longer dominates the measurement
+    # (at the old 2M default the RTT floor capped e2e_eps at ~30-50M
+    # regardless of pipeline speed); ~20MB of pair40 wire, affordable
+    # against the burst budget after the settle
+    e2e_edges = int(os.environ.get("GELLY_BENCH_E2E_EDGES", 1 << 22))
     batch = min(batch, num_edges)
     # a full-batch stream keeps every timed transfer in wire format (a raw
     # padded tail would ship 9 B/edge for its remainder)

@@ -79,6 +79,51 @@ def test_unbounded_generator_emits_running_components():
     out.close()
 
 
+def test_arrival_count_panes_fuzz_partition_exactly():
+    """Property fuzz: over random batch/pane geometries, the emitted panes
+    are EXACTLY the arrival stream re-chunked at every_edges — same edges,
+    same order, contiguous ascending ids, all full except the last."""
+    rng = np.random.default_rng(17)
+    for _ in range(25):
+        n_batches = int(rng.integers(0, 6))
+        sizes = [int(rng.integers(0, 9)) for _ in range(n_batches)]
+        every = int(rng.integers(1, 8))
+        chunks = []
+        base = 0
+        for s in sizes:
+            chunks.append(
+                (
+                    np.arange(base, base + s, dtype=np.int64) % 64,
+                    np.arange(base, base + s, dtype=np.int64) * 3 % 64,
+                )
+            )
+            base += s
+        panes = list(
+            assign_ingestion_windows(_batches(chunks)(), every_edges=every)
+        )
+        all_src = np.concatenate(
+            [c[0] for c in chunks] or [np.empty(0, np.int64)]
+        )
+        all_dst = np.concatenate(
+            [c[1] for c in chunks] or [np.empty(0, np.int64)]
+        )
+        total = len(all_src)
+        want_panes = -(-total // every) if total else 0
+        assert [p.window_id for p in panes] == list(range(want_panes))
+        got_src = np.concatenate(
+            [p.src for p in panes] or [np.empty(0, np.int64)]
+        )
+        got_dst = np.concatenate(
+            [p.dst for p in panes] or [np.empty(0, np.int64)]
+        )
+        assert np.array_equal(got_src, all_src), (sizes, every)
+        assert np.array_equal(got_dst, all_dst), (sizes, every)
+        for p in panes[:-1]:
+            assert p.num_edges == every, (sizes, every)
+        if panes:
+            assert panes[-1].num_edges == total - every * (want_panes - 1)
+
+
 def test_ingest_panes_match_global_pane_final_summary():
     """Finite stream: the LAST running summary equals the single-global-pane
     result (same edges, same order-free fold) and finite goldens without the
