@@ -77,3 +77,47 @@ def test_random_chain_wire_matches_simulated(seed):
         np.asarray(sim_out[-1][0].seen),
         err_msg=f"chain={names}",
     )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_chain_with_ingestion_panes_matches_global(seed):
+    """The same random chains under ingestion-time panes: the FINAL running
+    summary must equal the single-global-pane result on both an aligned pane
+    size (stays on the wire fast path) and a misaligned one (pane assembler
+    path) — panes must never drop, duplicate, or reorder chain output."""
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(50, 400))
+    src = rng.integers(0, CAP, n).astype(np.int32)
+    dst = rng.integers(0, CAP, n).astype(np.int32)
+    batch = int(rng.choice([16, 32, 64]))
+    ops = [CHAIN_OPS[i] for i in rng.choice(len(CHAIN_OPS), rng.integers(0, 4))]
+    names = [name for name, _ in ops]
+
+    def run(ingest_edges, expect_wire=None):
+        cfg = StreamConfig(
+            vertex_capacity=CAP,
+            batch_size=batch,
+            ingest_window_edges=ingest_edges,
+        )
+        stream = EdgeStream.from_arrays(src, dst, cfg)
+        for _, op in ops:
+            stream = op(stream)
+        agg = ConnectedComponents()
+        if expect_wire is not None:  # pin which execution path runs
+            assert agg._wire_eligible(stream) == expect_wire
+        return stream.aggregate(agg).collect()
+
+    plain = run(0)
+    aligned = run(batch, expect_wire=True)  # one pane/batch: wire fast path
+    misaligned = run(max(1, batch - 3), expect_wire=False)  # assembler path
+    for variant, out in (("aligned", aligned), ("misaligned", misaligned)):
+        np.testing.assert_array_equal(
+            _labels(out),
+            _labels(plain),
+            err_msg=f"chain={names} panes={variant}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out[-1][0].seen),
+            np.asarray(plain[-1][0].seen),
+            err_msg=f"chain={names} panes={variant}",
+        )
