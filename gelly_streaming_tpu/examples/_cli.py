@@ -21,14 +21,31 @@ def _honor_platform_env() -> None:
     the env var alone does not stop the plugin from probing its device at
     backend init — a CLI asked to run on CPU would hang whenever the tunnel
     is down.  The config update (applied before any device use, as in
-    tests/conftest.py) does.  No-op when the env var is unset.
+    tests/conftest.py) does.  No-op when the env var is unset, and —
+    critically — when the embedding program already pinned ``jax_platforms``
+    itself: a caller's explicit ``jax.config.update`` must never be
+    overridden by ambient environment (the session env pins its device
+    platform globally; clobbering a script's CPU choice with it re-hangs
+    exactly the case this helper exists to fix).
     """
     plats = os.environ.get("JAX_PLATFORMS")
-    if plats:
-        import jax
+    if not plats:
+        return
+    import jax
 
+    current = jax.config.jax_platforms or ""
+    want = [p.strip() for p in plats.split(",") if p.strip()]
+    have = [p.strip() for p in current.split(",") if p.strip()]
+    # Apply the env only when it NARROWS the current platform list (picks a
+    # subset of what config already allows — e.g. env "cpu" against the
+    # plugin site hook's "axon,cpu").  If the env names platforms config
+    # does not currently hold, the config value is an explicit caller
+    # choice (e.g. a script's jax.config.update("jax_platforms", "cpu")
+    # with the session env still pinning the device platform) — never
+    # clobber that.
+    if not have or (set(want) <= set(have) and want != have):
         try:
-            jax.config.update("jax_platforms", plats)
+            jax.config.update("jax_platforms", ",".join(want))
         except Exception:
             pass  # backend already initialized: keep whatever it picked
 

@@ -81,9 +81,53 @@ def measure_degrees(args) -> dict:
         "edges_folded": folded,
         "degree_total": total,
     }
+    proxy = _degree_flink_proxy(args, folded, np.asarray(counts))
+    if proxy:
+        out.update(proxy)
     if getattr(args, "trace", False):
         out.update(_measure_degree_trace(args))
     return out
+
+
+def _degree_flink_proxy(args, folded, device_counts) -> dict:
+    """Measured Flink-shaped denominator for BASELINE row 1 (Continuous
+    Degree Aggregate): the same record-at-a-time stack as the CC proxy —
+    Tuple2 serialize + keyBy hash + socketpair shuffle — folding per-key
+    HashMap degree counts (SimpleEdgeStream.java:461-478's DegreeMapFunction
+    state), in optimized C++ (native/edge_parser.cpp flink_proxy_degrees).
+    The proxy folds exactly the ``folded`` prefix the device harness folded
+    (wire_stream_fold folds full batches only), so counts cross-check."""
+    import ctypes
+    import statistics
+
+    from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+    lib = load_ingest_lib()
+    if lib is None or not hasattr(lib, "flink_proxy_degrees"):
+        return {}
+    rng = np.random.default_rng(args.seed)
+    src = rng.integers(0, args.vertices, args.edges).astype(np.int32)
+    dst = rng.integers(0, args.vertices, args.edges).astype(np.int32)
+    cnt = np.empty(args.vertices, np.int64)
+    trials = []
+    for _ in range(3):
+        ns = lib.flink_proxy_degrees(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            folded,
+            cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            args.vertices,
+        )
+        if ns <= 0:
+            return {}
+        trials.append(folded / (ns / 1e9))
+    return {
+        "flink_proxy_eps": round(statistics.median(trials), 1),
+        # the harness folds the same seeded stream, so totals must agree
+        "flink_proxy_counts_ok": bool(
+            np.array_equal(cnt, device_counts.astype(np.int64))
+        ),
+    }
 
 
 def _measure_degree_trace(args) -> dict:

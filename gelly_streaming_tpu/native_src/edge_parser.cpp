@@ -520,3 +520,80 @@ extern "C" int64_t flink_proxy_cc(const int32_t* src, const int32_t* dst,
   if (consumed != n) return -1;
   return std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0).count();
 }
+
+// Degrees variant of the proxy — BASELINE row 1's denominator.  Identical
+// producer stage (per-record Tuple2 serialize + keygroup + socketpair hop in
+// 32 KiB buffers); the consumer folds each record into per-key HashMap degree
+// counts, the reference's DegreeMapFunction state
+// (SimpleEdgeStream.java:461-478: HashMap<K, Long> bumped per endpoint).
+// Writes final counts (0 for never-seen vertices) into out_counts.
+extern "C" int64_t flink_proxy_degrees(const int32_t* src, const int32_t* dst,
+                                       int64_t n, int64_t* out_counts,
+                                       int32_t capacity) {
+  int fds[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, fds) != 0) return -1;
+  auto t0 = std::chrono::steady_clock::now();
+  static volatile uint32_t degree_sink;
+  std::thread producer([&] {
+    uint8_t buf[kNetBuf];
+    size_t fill = 0;
+    uint32_t sink = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t s = static_cast<uint32_t>(src[i]);
+      uint32_t d = static_cast<uint32_t>(dst[i]);
+      sink ^= fp_keygroup(s);
+      buf[fill++] = static_cast<uint8_t>(s >> 24);
+      buf[fill++] = static_cast<uint8_t>(s >> 16);
+      buf[fill++] = static_cast<uint8_t>(s >> 8);
+      buf[fill++] = static_cast<uint8_t>(s);
+      buf[fill++] = static_cast<uint8_t>(d >> 24);
+      buf[fill++] = static_cast<uint8_t>(d >> 16);
+      buf[fill++] = static_cast<uint8_t>(d >> 8);
+      buf[fill++] = static_cast<uint8_t>(d);
+      if (fill == kNetBuf) {
+        if (!fp_write_all(fds[0], buf, fill)) break;
+        fill = 0;
+      }
+    }
+    if (fill) fp_write_all(fds[0], buf, fill);
+    degree_sink = sink;
+    shutdown(fds[0], SHUT_WR);
+  });
+  std::unordered_map<int32_t, int64_t> counts;
+  uint8_t rbuf[kNetBuf];
+  size_t have = 0;
+  int64_t consumed = 0;
+  while (true) {
+    ssize_t r = read(fds[1], rbuf + have, kNetBuf - have);
+    if (r <= 0) break;
+    have += static_cast<size_t>(r);
+    size_t off = 0;
+    while (have - off >= 8) {
+      const uint8_t* p = rbuf + off;
+      int32_t s = static_cast<int32_t>(
+          (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+          (uint32_t(p[2]) << 8) | uint32_t(p[3]));
+      int32_t d = static_cast<int32_t>(
+          (uint32_t(p[4]) << 24) | (uint32_t(p[5]) << 16) |
+          (uint32_t(p[6]) << 8) | uint32_t(p[7]));
+      off += 8;
+      ++counts[s];
+      ++counts[d];
+      ++consumed;
+    }
+    memmove(rbuf, rbuf + off, have - off);
+    have -= off;
+  }
+  producer.join();
+  auto t1 = std::chrono::steady_clock::now();
+  close(fds[0]);
+  close(fds[1]);
+  if (out_counts) {
+    for (int32_t v = 0; v < capacity; ++v) {
+      auto it = counts.find(v);
+      out_counts[v] = (it == counts.end()) ? 0 : it->second;
+    }
+  }
+  if (consumed != n) return -1;
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0).count();
+}

@@ -154,6 +154,15 @@ def load_ingest_lib():
                 ctypes.c_int32,
             ]
             lib.flink_proxy_cc.restype = ctypes.c_int64
+        if hasattr(lib, "flink_proxy_degrees"):
+            lib.flink_proxy_degrees.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32,
+            ]
+            lib.flink_proxy_degrees.restype = ctypes.c_int64
         if hasattr(lib, "pack_edges_ef40"):
             lib.pack_edges_ef40.argtypes = [
                 ctypes.POINTER(ctypes.c_int32),

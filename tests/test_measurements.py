@@ -27,6 +27,11 @@ def test_degrees_conserves_edge_endpoints(capsys):
     assert out["edges_folded"] == 4096
     # every folded edge contributes exactly two endpoint counts
     assert out["degree_total"] == 2 * out["edges_folded"]
+    # the measured Flink-shaped denominator folds the same seeded stream
+    # through per-key HashMap state; its counts must match the device fold
+    if "flink_proxy_eps" in out:
+        assert out["flink_proxy_eps"] > 0
+        assert out["flink_proxy_counts_ok"] is True
 
 
 def test_degrees_small_edges_shrink_batch(capsys):
