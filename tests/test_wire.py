@@ -182,6 +182,32 @@ def test_ef40_native_matches_numpy(monkeypatch):
     np.testing.assert_array_equal(native_buf, numpy_buf)
 
 
+def test_ef40_native_blocked_path_matches_numpy(monkeypatch):
+    """Parity on the cache-blocked native sort (capacity > 2^14, n >= 2^16).
+
+    The native pack switches to a two-level bucketed counting sort at scale;
+    these shapes force that path — including a capacity that is not a
+    multiple of the 2^12 bucket span (partial last bucket) and odd n — so
+    a regression in the bucket scatter or the done-based prefix cannot ship
+    behind the small-shape parity test above.
+    """
+    lib = load_ingest_lib()
+    if lib is None or not hasattr(lib, "pack_edges_ef40"):
+        pytest.skip("native pack_edges_ef40 unavailable")
+    for n, cap, seed in [
+        ((1 << 16) + 1, 1 << 20, 15),       # blocked, odd n, full capacity
+        (1 << 16, (1 << 20) - 333, 16),     # partial last bucket
+        ((1 << 16) + 7, (1 << 15) + 5, 17), # small odd capacity, odd n
+    ]:
+        src, dst = _random_edges(n, cap, seed=seed)
+        src[: n // 8] = 42  # skewed hot vertex crossing bucket boundaries
+        native_buf = wire.pack_edges(src, dst, (wire.EF40, cap))
+        with monkeypatch.context() as m:
+            m.setattr(wire, "load_ingest_lib", lambda: None)
+            numpy_buf = wire.pack_edges(src, dst, (wire.EF40, cap))
+        np.testing.assert_array_equal(native_buf, numpy_buf)
+
+
 def test_ef40_odd_and_duplicate_edges():
     import jax.numpy as jnp
 
