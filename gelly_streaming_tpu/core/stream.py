@@ -921,11 +921,24 @@ class EdgeStream:
 
     # ---- windows & aggregations (defined in sibling modules) ----------------
 
-    def slice(self, window_ms: Optional[int] = None, direction: EdgeDirection = EdgeDirection.OUT):
-        """Tumbling-window snapshot stream (SimpleEdgeStream.java:135-167)."""
+    def slice(
+        self,
+        window_ms: Optional[int] = None,
+        direction: EdgeDirection = EdgeDirection.OUT,
+        slide_ms: Optional[int] = None,
+    ):
+        """Windowed snapshot stream (SimpleEdgeStream.java:135-167).
+
+        Tumbling by default; pass ``slide_ms`` (must divide ``window_ms``)
+        for sliding windows of size ``window_ms`` emitted every ``slide_ms``
+        — beyond the tumbling-only reference, implemented by pane-sharing
+        (core/windows.sliding_panes) so each edge is assembled once per
+        slide, not once per window."""
         from gelly_streaming_tpu.core.snapshot import SnapshotStream
 
-        return SnapshotStream(self, window_ms or self.cfg.window_ms, direction)
+        return SnapshotStream(
+            self, window_ms or self.cfg.window_ms, direction, slide_ms
+        )
 
     def aggregate(
         self,

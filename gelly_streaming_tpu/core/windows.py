@@ -218,6 +218,114 @@ def assign_ingestion_windows(
         yield panes.close(wid)
 
 
+def sliding_panes(
+    panes: Iterator[WindowPane], k: int, slide_ms: int
+) -> Iterator[WindowPane]:
+    """Sliding windows by pane-sharing: merge each run of ``k`` consecutive
+    ``slide_ms``-wide tumbling panes into one emitted window.
+
+    Beyond the reference (its ``slice`` is tumbling-only,
+    SimpleEdgeStream.java:135-167), matching the sliding ``timeWindow(size,
+    slide)`` Flink exposes one call below it: window ``w`` covers panes
+    ``[w-k+1, w]`` and is emitted when pane ``w`` closes (the upstream
+    assigner only yields final panes, so every pane <= w is final by then).
+    Early windows covering the stream's first panes are partial, windows
+    with no edges do not fire, and the trailing ``k-1`` windows after the
+    last pane flush at end-of-stream — all as in Flink's sliding trigger.
+    Each edge appears in up to ``k`` emitted windows; memory is bounded by
+    the ``k`` cached panes.  An untimed stream's single global pane
+    (``window_id=-1``) passes through unchanged.
+    """
+    if k <= 1:
+        yield from panes
+        return
+    import jax
+
+    cache = {}  # pane id -> WindowPane (the k most recent)
+    last = None  # newest window id emitted
+
+    def emit(wid: int) -> Optional[WindowPane]:
+        parts = [cache[i] for i in range(wid - k + 1, wid + 1) if i in cache]
+        if not parts or all(p.num_edges == 0 for p in parts):
+            return None
+        timed = any(p.max_timestamp >= 0 for p in parts)
+        src = np.concatenate([p.src for p in parts])
+        dst = np.concatenate([p.dst for p in parts])
+        val = None
+        if parts[0].val is not None:
+            val = jax.tree.map(
+                lambda *leaves: np.concatenate(leaves), *[p.val for p in parts]
+            )
+        time = (
+            None
+            if parts[0].time is None
+            else np.concatenate([p.time for p in parts])
+        )
+        max_ts = (wid + 1) * slide_ms - 1 if timed else -1
+        return WindowPane(wid, max_ts, src, dst, val, time)
+
+    def evict(wid: int) -> None:
+        for old in [i for i in cache if i <= wid + 1 - k]:
+            del cache[old]
+
+    for pane in panes:
+        if pane.window_id < 0:  # untimed global pane: degenerate window
+            yield pane
+            continue
+        w = pane.window_id
+        cache[w] = pane
+        # windows in (last+k-1, w) contain no cached pane (ids between last
+        # and w never arrived), so a timestamp gap costs O(k) work, not
+        # O(gap/slide) empty emit() calls
+        if last is None:
+            candidates = [w]
+        else:
+            candidates = [*range(last + 1, min(last + k, w)), w]
+        for wid in candidates:
+            out = emit(wid)
+            if out is not None:
+                yield out
+            evict(wid)
+        last = w
+
+    if last is not None:
+        for wid in range(last + 1, last + k):
+            if not cache:
+                break
+            out = emit(wid)
+            if out is not None:
+                yield out
+            evict(wid)
+
+
+def validate_slide(window_ms: int, slide_ms: Optional[int]) -> None:
+    """Eager check of a sliding-window spec (shared by every slide entry
+    point so the contract cannot diverge)."""
+    if slide_ms is None:
+        return
+    if not 0 < slide_ms <= window_ms:
+        raise ValueError(f"slide_ms must be in (0, window_ms]; got {slide_ms}")
+    if window_ms % slide_ms:
+        raise ValueError(
+            "window_ms must be a multiple of slide_ms for pane-shared "
+            f"sliding windows; got {window_ms} % {slide_ms}"
+        )
+
+
+def windowed_panes(
+    stream, window_ms: int, slide_ms: Optional[int] = None
+) -> Iterator[WindowPane]:
+    """Validated window-pane source: tumbling panes, or pane-shared sliding
+    windows when ``slide_ms`` (a divisor of ``window_ms``) is given.  The
+    single dispatch point for slice() and window_triangles."""
+    validate_slide(window_ms, slide_ms)
+    if slide_ms and slide_ms != window_ms:
+        return sliding_panes(
+            stream_panes(stream, slide_ms), window_ms // slide_ms, slide_ms
+        )
+    return stream_panes(stream, window_ms)
+
+
 def stream_panes(stream, window_ms: int) -> Iterator[WindowPane]:
     """The pane source for an aggregation over ``stream``: ingestion-time
     panes when the config asks for them, else event-time tumbling windows

@@ -29,7 +29,11 @@ import numpy as np
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.core.types import EdgeDirection
-from gelly_streaming_tpu.core.windows import stream_panes
+from gelly_streaming_tpu.core.windows import (
+    stream_panes,
+    validate_slide,
+    windowed_panes,
+)
 from gelly_streaming_tpu.ops import neighbors as nbr_ops
 from gelly_streaming_tpu.ops import pallas_triangles
 
@@ -242,17 +246,23 @@ def _count_kernel(u: jax.Array, v: jax.Array, num_vertices: int, max_deg: int):
     return jnp.sum(eq.astype(jnp.int32)) // 3
 
 
-def window_triangles(stream, window_ms: int) -> OutputStream:
+def window_triangles(
+    stream, window_ms: int, slide_ms: "int | None" = None
+) -> OutputStream:
     """(triangle_count, window_max_timestamp) per closed pane
     (output shape of WindowTriangles.java:60-65's final sum).
 
     Panes pipeline one deep: pane k+1's upload/compute is submitted before
     pane k's count is fetched, hiding the readback RTT behind device work.
+    ``slide_ms`` (must divide ``window_ms``) counts sliding windows via
+    pane-sharing (core/windows.sliding_panes) — beyond the tumbling-only
+    reference.
     """
+    validate_slide(window_ms, slide_ms)
 
     def records() -> Iterator[tuple]:
         pending = None  # (handle, timestamp) of the previous pane
-        for pane in stream_panes(stream, window_ms):
+        for pane in windowed_panes(stream, window_ms, slide_ms):
             try:
                 handle = _pane_triangle_submit(pane.src, pane.dst)
             except BaseException:

@@ -1,0 +1,220 @@
+"""Sliding-window slice(): pane-shared windows beyond the tumbling-only
+reference (SimpleEdgeStream.java:135-167 exposes only timeWindow(size); Flink
+itself offers timeWindow(size, slide) one call below — this is the framework's
+native equivalent, built from core/windows.sliding_panes).
+
+Semantics pinned here: window w covers panes [w-k+1, w] (k = size // slide),
+fires when pane w closes, partial early windows fire, empty windows do not,
+and the trailing k-1 windows flush at end-of-stream.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.core.types import EdgeDirection
+from gelly_streaming_tpu.core.windows import WindowPane, sliding_panes
+
+
+def _pane(wid, edges, slide=1000):
+    src = np.array([e[0] for e in edges], np.int32)
+    dst = np.array([e[1] for e in edges], np.int32)
+    max_ts = (wid + 1) * slide - 1 if wid >= 0 else -1
+    return WindowPane(wid, max_ts, src, dst, None, None)
+
+
+def _ids(pane):
+    return sorted(zip(pane.src.tolist(), pane.dst.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# unit: sliding_panes
+
+
+def test_sliding_windows_share_panes():
+    panes = [_pane(0, [(1, 2)]), _pane(1, [(3, 4)]), _pane(2, [(5, 6)])]
+    out = list(sliding_panes(iter(panes), 2, 1000))
+    # windows: 0:{p0} (partial early), 1:{p0,p1}, 2:{p1,p2}, trailing 3:{p2}
+    assert [w.window_id for w in out] == [0, 1, 2, 3]
+    assert _ids(out[0]) == [(1, 2)]
+    assert _ids(out[1]) == [(1, 2), (3, 4)]
+    assert _ids(out[2]) == [(3, 4), (5, 6)]
+    assert _ids(out[3]) == [(5, 6)]
+    # window end timestamps advance by the slide
+    assert [w.max_timestamp for w in out] == [999, 1999, 2999, 3999]
+
+
+def test_sliding_windows_skip_empty_gaps():
+    panes = [_pane(0, [(1, 2)]), _pane(5, [(7, 8)])]
+    out = list(sliding_panes(iter(panes), 3, 1000))
+    # pane 0 is in windows 0-2; panes 1-4 are empty so windows 3-4 never
+    # fire; pane 5 is in windows 5-7
+    assert [w.window_id for w in out] == [0, 1, 2, 5, 6, 7]
+    assert all(_ids(w) == [(1, 2)] for w in out[:3])
+    assert all(_ids(w) == [(7, 8)] for w in out[3:])
+
+
+def test_sliding_k1_and_untimed_pass_through():
+    panes = [_pane(0, [(1, 2)]), _pane(1, [(3, 4)])]
+    assert list(sliding_panes(iter(panes), 1, 1000)) == panes
+    untimed = [_pane(-1, [(1, 2)])]
+    assert list(sliding_panes(iter(untimed), 4, 1000)) == untimed
+
+
+def test_sliding_windows_bounded_cache():
+    # only k panes may be cached at once, whatever the stream length
+    import itertools
+
+    def gen():
+        for w in itertools.count():
+            yield _pane(w, [(w, w + 1)])
+
+    out = sliding_panes(gen(), 4, 1000)
+    for _ in range(100):
+        next(out)
+    # windows past the warmup each hold exactly k panes' edges
+    w = next(out)
+    assert w.num_edges == 4
+
+
+# ---------------------------------------------------------------------------
+# integration: slice(window, slide) through reduce_on_edges, differentially
+# against a per-window host recompute
+
+
+TIMED_EDGES = [
+    # (src, dst, val, t_ms) — panes of 1000 ms: t//1000 in {0, 0, 1, 2, 4}
+    (1, 2, 10, 100),
+    (3, 1, 7, 900),
+    (1, 4, 5, 1500),
+    (2, 3, 20, 2400),
+    (4, 1, 2, 4700),
+]
+
+
+def _host_windows(k):
+    """Expected (vid, sum) records across all fired sliding windows."""
+    pane_of = {i: e[3] // 1000 for i, e in enumerate(TIMED_EDGES)}
+    first, last = min(pane_of.values()), max(pane_of.values())
+    recs = []
+    for wid in range(first, last + k):
+        sums = {}
+        for i, (s, _, v, _) in enumerate(TIMED_EDGES):
+            if wid - k + 1 <= pane_of[i] <= wid:
+                sums[s] = sums.get(s, 0) + v
+        recs.extend(sums.items())
+    return sorted(recs)
+
+
+@pytest.mark.parametrize("window,slide,k", [(2000, 1000, 2), (3000, 1000, 3)])
+def test_slice_sliding_reduce_matches_host(window, slide, k):
+    cfg = StreamConfig(vertex_capacity=16, max_degree=16, batch_size=2)
+    stream = EdgeStream.from_collection(
+        TIMED_EDGES, cfg, batch_size=2, with_time=True
+    )
+    out = stream.slice(window, EdgeDirection.OUT, slide_ms=slide).reduce_on_edges(
+        lambda a, b: a + b
+    )
+    assert sorted(tuple(r) for r in out.collect()) == _host_windows(k)
+
+
+def test_slice_slide_equal_window_is_tumbling():
+    cfg = StreamConfig(vertex_capacity=16, max_degree=16, batch_size=2)
+
+    def run(**kw):
+        return sorted(
+            tuple(r)
+            for r in EdgeStream.from_collection(
+                TIMED_EDGES, cfg, batch_size=2, with_time=True
+            )
+            .slice(2000, EdgeDirection.OUT, **kw)
+            .reduce_on_edges(lambda a, b: a + b)
+            .collect()
+        )
+
+    assert run(slide_ms=2000) == run()
+
+
+def test_slice_sliding_validation():
+    cfg = StreamConfig(vertex_capacity=16, max_degree=16, batch_size=2)
+    stream = EdgeStream.from_collection(TIMED_EDGES, cfg, with_time=True)
+    with pytest.raises(ValueError, match="multiple"):
+        stream.slice(2000, EdgeDirection.OUT, slide_ms=1500)
+    with pytest.raises(ValueError, match="slide_ms"):
+        stream.slice(2000, EdgeDirection.OUT, slide_ms=0)
+    with pytest.raises(ValueError, match="slide_ms"):
+        stream.slice(2000, EdgeDirection.OUT, slide_ms=3000)
+
+
+def test_slice_sliding_sharded_matches_single():
+    """The mesh path shares _panes(): sliding windows must agree with the
+    single-device kernel over the 8-device mesh."""
+    single = StreamConfig(vertex_capacity=16, max_degree=16, batch_size=2)
+    sharded = StreamConfig(
+        vertex_capacity=16, max_degree=16, batch_size=2, num_shards=8
+    )
+
+    def run(cfg):
+        return sorted(
+            tuple(r)
+            for r in EdgeStream.from_collection(
+                TIMED_EDGES, cfg, batch_size=2, with_time=True
+            )
+            .slice(2000, EdgeDirection.OUT, slide_ms=1000)
+            .reduce_on_edges(lambda a, b: a + b)
+            .collect()
+        )
+
+    assert run(sharded) == run(single)
+
+
+def test_window_triangles_sliding():
+    """Sliding triangle counts: each window's count equals a host recount of
+    the union of its panes (WindowTriangles semantics over sliding panes)."""
+    from gelly_streaming_tpu.library.triangles import window_triangles
+
+    edges = [
+        # pane 0: triangle 1-2-3; pane 1: edges 3-4, 4-5; pane 2: 3-5
+        (1, 2, 0, 100),
+        (2, 3, 0, 200),
+        (1, 3, 0, 300),
+        (3, 4, 0, 1100),
+        (4, 5, 0, 1200),
+        (3, 5, 0, 2100),
+    ]
+    cfg = StreamConfig(vertex_capacity=16, max_degree=16, batch_size=2)
+
+    def host_count(pane_ids):
+        es = {
+            frozenset((s, d))
+            for s, d, _, t in edges
+            if t // 1000 in pane_ids
+        }
+        vs = sorted({v for e in es for v in e})
+        cnt = 0
+        for i, a in enumerate(vs):
+            for b in vs[i + 1 :]:
+                for c in vs[vs.index(b) + 1 :]:
+                    if (
+                        frozenset((a, b)) in es
+                        and frozenset((b, c)) in es
+                        and frozenset((a, c)) in es
+                    ):
+                        cnt += 1
+        return cnt
+
+    stream = EdgeStream.from_collection(edges, cfg, batch_size=2, with_time=True)
+    got = window_triangles(stream, 2000, slide_ms=1000).collect()
+    # windows: 0:{p0} 1:{p0,p1} 2:{p1,p2} trailing 3:{p2}
+    want = [
+        host_count({0}),
+        host_count({0, 1}),
+        host_count({1, 2}),
+        host_count({2}),
+    ]
+    assert [c for c, _ in got] == want
+    # window 2 closes the 3-4-5 triangle across panes 1+2
+    assert want == [1, 1, 1, 0]
+    with pytest.raises(ValueError, match="multiple"):
+        window_triangles(stream, 2000, slide_ms=1500)
