@@ -64,10 +64,29 @@ class StreamConfig:
     # pipeline, as the reference's two ctors do).
     ingest_window_edges: int = 0
     ingest_window_ms: int = 0
+    # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
+    # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
+    # values trail the watermark behind max seen time by the bound, holding
+    # windows open for stragglers and routing later-than-bound records to
+    # the late sink (core/windows.assign_tumbling_windows).
+    # Applies to the single-host event-time assigner only: the multi-host
+    # gated assigners (parallel/multihost.py) close panes on GLOBAL
+    # watermark agreement with their own on_late callback and do not use
+    # this bound.
+    out_of_orderness_ms: int = 0
 
     def __post_init__(self):
         if self.wire_encoding not in ("auto", "plain", "ef40"):
             raise ValueError(f"unknown wire_encoding {self.wire_encoding!r}")
+        if self.out_of_orderness_ms < 0:
+            raise ValueError("out_of_orderness_ms must be >= 0")
+        if self.out_of_orderness_ms and (
+            self.ingest_window_edges or self.ingest_window_ms
+        ):
+            raise ValueError(
+                "out_of_orderness_ms applies to event-time windows only; "
+                "ingestion-time panes window by arrival order"
+            )
         if self.ingest_window_edges < 0 or self.ingest_window_ms < 0:
             raise ValueError("ingest window knobs must be >= 0")
         if self.ingest_window_edges and self.ingest_window_ms:

@@ -184,6 +184,24 @@ class EdgeStream:
         # are ALREADY in wire format (from_wire): the fast path skips host
         # packing entirely and the timed cost is transfer + on-device unpack.
         self._wire_packed = wire_packed
+        # shared holder for the late-record sink: derived streams (_with)
+        # alias the SAME holder, so on_late() attached to any stream in a
+        # transform chain is seen by every stream derived from it — before
+        # or after the derivation
+        self._late_holder = {"sink": None}
+
+    @property
+    def late_sink(self):
+        """callable(src, dst, val, time) for later-than-bound records
+        (None = drop); shared across a transform chain."""
+        return self._late_holder["sink"]
+
+    def on_late(self, sink) -> "EdgeStream":
+        """Route later-than-bound event-time records to ``sink(src, dst,
+        val, time)`` instead of dropping them (Flink's side-output-for-late
+        analog; used with ``cfg.out_of_orderness_ms`` > 0)."""
+        self._late_holder["sink"] = sink
+        return self
 
     # ---- construction -------------------------------------------------------
 
@@ -377,7 +395,7 @@ class EdgeStream:
         )
 
     def _with(self, stage: Stage, valued: Optional[bool] = None) -> "EdgeStream":
-        return EdgeStream(
+        out = EdgeStream(
             self._source_factory,
             self.cfg,
             self._stages + (stage,),
@@ -385,6 +403,8 @@ class EdgeStream:
             wire_packed=self._wire_packed,
             valued=self._valued if valued is None else valued,
         )
+        out._late_holder = self._late_holder  # alias: one sink per chain
+        return out
 
     # ---- transformations (lazy) --------------------------------------------
 

@@ -135,11 +135,26 @@ class PaneAssembler:
 
 
 def assign_tumbling_windows(
-    batches: Iterator[EdgeBatch], window_ms: int
+    batches: Iterator[EdgeBatch],
+    window_ms: int,
+    out_of_orderness_ms: int = 0,
+    late_sink=None,
 ) -> Iterator[WindowPane]:
-    """Group an (ascending-time) batch stream into closed tumbling panes."""
+    """Group a timed batch stream into closed tumbling panes.
+
+    With the default ``out_of_orderness_ms=0`` timestamps are assumed
+    ascending (the reference's AscendingTimestampExtractor contract,
+    SimpleEdgeStream.java:86-90).  A positive bound is the
+    BoundedOutOfOrderness watermark Flink offers one call below the
+    reference: the watermark trails the max seen timestamp by the bound,
+    window ``w`` closes only once the watermark passes its end, and records
+    later than the bound — whose window already closed — go to
+    ``late_sink(src, dst, val, time)`` (dropped when None) instead of
+    corrupting closed panes.  Pane emission stays ascending either way,
+    which downstream sliding_panes relies on.
+    """
     panes = PaneAssembler(window_ms)
-    watermark_id = -1
+    watermark = None  # max event time seen - bound
 
     for batch in batches:
         src, dst, val, time = _batch_to_host(batch)
@@ -149,12 +164,40 @@ def assign_tumbling_windows(
             panes.add_untimed(src, dst, val)
             continue
         wids = time // window_ms
+        if watermark is not None:
+            late = (wids + 1) * window_ms <= watermark
+            if late.any():
+                if late_sink is not None:
+                    import jax
+
+                    sel = np.nonzero(late)[0]
+                    late_sink(
+                        src[sel],
+                        dst[sel],
+                        None
+                        if val is None
+                        else jax.tree.map(lambda a: a[sel], val),
+                        time[sel],
+                    )
+                keep = ~late
+                src, dst = src[keep], dst[keep]
+                time, wids = time[keep], wids[keep]
+                if val is not None:
+                    import jax
+
+                    val = jax.tree.map(lambda a: a[keep], val)
+                if len(src) == 0:
+                    continue
         panes.add(src, dst, val, time, wids)
-        new_watermark = int(wids.max())
-        if new_watermark > watermark_id:
-            for wid in [w for w in panes.open_ids() if 0 <= w < new_watermark]:
+        new_watermark = int(time.max()) - out_of_orderness_ms
+        if watermark is None or new_watermark > watermark:
+            watermark = new_watermark
+            for wid in [
+                w
+                for w in panes.open_ids()
+                if 0 <= w and (w + 1) * window_ms <= watermark
+            ]:
                 yield panes.close(wid)
-            watermark_id = new_watermark
 
     for wid in panes.open_ids():
         yield panes.close(wid)
@@ -339,4 +382,9 @@ def stream_panes(stream, window_ms: int) -> Iterator[WindowPane]:
             cfg.ingest_window_edges,
             cfg.ingest_window_ms,
         )
-    return assign_tumbling_windows(stream.batches(), window_ms)
+    return assign_tumbling_windows(
+        stream.batches(),
+        window_ms,
+        out_of_orderness_ms=cfg.out_of_orderness_ms,
+        late_sink=getattr(stream, "late_sink", None),
+    )

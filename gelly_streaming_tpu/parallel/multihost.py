@@ -254,7 +254,10 @@ def multihost_tumbling_windows(
 
     Same pane assembly as core/windows.py:assign_tumbling_windows, but a pane
     [w*window_ms, (w+1)*window_ms) is yielded only once every host's watermark
-    has passed w — the straggler-safe close.  All hosts yield shares (possibly
+    has passed w — the straggler-safe close.  Cross-host stragglers are
+    handled by that global agreement (plus the ``on_late`` callback for
+    records behind this host's own mark); ``StreamConfig.out_of_orderness_ms``
+    is a single-host-assigner knob and does not apply here.  All hosts yield shares (possibly
     empty) of the same pane ids in the same order.  For value-carrying
     streams pass ``val_proto`` (a pytree of zero-length arrays) so an empty
     share closed before this host's first val batch stays shape-compatible

@@ -1,0 +1,180 @@
+"""Bounded event-time out-of-orderness: watermark trails max-seen time by
+``cfg.out_of_orderness_ms``; windows stay open for stragglers inside the
+bound; records beyond it route to the late sink (drop by default).
+
+Beyond the reference's ascending-only contract
+(SimpleEdgeStream.java:86-90) — the BoundedOutOfOrderness analog of the
+Flink watermark assigner the reference sits one call above.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.core.types import EdgeDirection
+
+
+def _stream(edges, bound, batch_size=2, **extra):
+    cfg = StreamConfig(
+        vertex_capacity=16,
+        max_degree=16,
+        batch_size=batch_size,
+        out_of_orderness_ms=bound,
+        **extra,
+    )
+    return EdgeStream.from_collection(
+        edges, cfg, batch_size=batch_size, with_time=True
+    )
+
+
+def _reduce_records(stream, window=1000, slide=None):
+    out = stream.slice(window, EdgeDirection.OUT, slide_ms=slide).reduce_on_edges(
+        lambda a, b: a + b
+    )
+    return sorted(tuple(r) for r in out.collect())
+
+
+def test_in_bound_stragglers_join_their_window():
+    # the t=800 edge arrives AFTER t=1500 — inside a 1000 ms bound, so
+    # window 0 must still be open and include it
+    edges = [
+        (1, 2, 10, 100),
+        (3, 4, 5, 1500),
+        (1, 5, 7, 800),  # straggler for window 0
+        (2, 3, 9, 2600),
+    ]
+    got = _reduce_records(_stream(edges, bound=1000))
+    # window 0: 1 -> 17; window 1: 3 -> 5; window 2: 2 -> 9
+    assert got == [(1, 17), (2, 9), (3, 5)]
+
+
+def test_beyond_bound_records_are_dropped():
+    # with bound=0 (ascending contract) the t=800 record arrives after the
+    # watermark passed 1000 -> its window is closed -> dropped
+    edges = [
+        (1, 2, 10, 100),
+        (3, 4, 5, 1500),
+        (1, 5, 7, 800),  # late beyond bound
+        (2, 3, 9, 2600),
+    ]
+    got = _reduce_records(_stream(edges, bound=0))
+    assert got == [(1, 10), (2, 9), (3, 5)]
+
+
+def test_late_sink_receives_dropped_records():
+    edges = [
+        (1, 2, 10, 100),
+        (3, 4, 5, 1500),
+        (1, 5, 7, 800),
+        (2, 3, 9, 2600),
+    ]
+    lates = []
+
+    def sink(src, dst, val, time):
+        lates.extend(zip(src.tolist(), dst.tolist(), time.tolist()))
+
+    got = _reduce_records(_stream(edges, bound=0).on_late(sink))
+    assert got == [(1, 10), (2, 9), (3, 5)]
+    assert lates == [(1, 5, 800)]
+
+
+def test_late_sink_survives_transforms():
+    edges = [
+        (1, 2, 10, 100),
+        (3, 4, 5, 1500),
+        (1, 5, 7, 800),
+        (2, 3, 9, 2600),
+    ]
+    lates = []
+    stream = _stream(edges, bound=0).on_late(
+        lambda s, d, v, t: lates.append(len(s))
+    )
+    _reduce_records(stream.filter_edges(lambda s, d, v: d < 10))
+    assert lates == [1]
+
+
+def test_watermark_holds_windows_open():
+    # bound 2000: nothing may close until max_t - 2000 passes a window end;
+    # all three windows flush at end-of-stream with stragglers included
+    edges = [
+        (1, 2, 1, 100),
+        (2, 3, 1, 2900),
+        (1, 4, 1, 200),  # straggler, still within 2000 of 2900
+        (3, 5, 1, 1100),  # straggler for window 1
+    ]
+    got = _reduce_records(_stream(edges, bound=2000))
+    assert got == [(1, 2), (2, 1), (3, 1)]
+
+
+def test_out_of_order_with_sliding_windows():
+    edges = [
+        (1, 2, 10, 100),
+        (3, 4, 5, 1500),
+        (1, 5, 7, 800),  # straggler joins pane 0
+        (2, 3, 9, 2600),
+    ]
+    got = _reduce_records(_stream(edges, bound=1000), window=2000, slide=1000)
+    # panes: 0:{(1,17)}, 1:{(3,5)}, 2:{(2,9)}
+    # windows (k=2): 0:{p0} 1:{p0,p1} 2:{p1,p2} 3:{p2}
+    want = sorted(
+        [(1, 17), (1, 17), (3, 5), (3, 5), (2, 9), (2, 9)]
+    )
+    assert got == want
+
+
+def test_ascending_streams_unchanged_by_bound_zero():
+    edges = [
+        (1, 2, 10, 100),
+        (3, 1, 7, 900),
+        (1, 4, 5, 1500),
+        (2, 3, 20, 2400),
+    ]
+    assert _reduce_records(_stream(edges, bound=0)) == _reduce_records(
+        _stream(edges, bound=0, batch_size=4)
+    )
+
+
+def test_negative_bound_rejected():
+    with pytest.raises(ValueError, match="out_of_orderness"):
+        StreamConfig(vertex_capacity=16, out_of_orderness_ms=-1)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_out_of_order_differential_vs_sorted(seed):
+    """Shuffled-within-bound streams must window identically to the fully
+    sorted stream (the bound makes the shuffle invisible)."""
+    rng = np.random.default_rng(seed)
+    n = 30
+    times = np.sort(rng.integers(0, 6000, n))
+    edges = [
+        (int(rng.integers(1, 8)), int(rng.integers(1, 8)), int(rng.integers(1, 50)), int(t))
+        for t in times
+    ]
+    # bounded shuffle: swap adjacent pairs (displacement <= 1 batch stays
+    # well inside a 2000 ms bound for this time density)
+    shuffled = list(edges)
+    for i in range(0, n - 1, 2):
+        shuffled[i], shuffled[i + 1] = shuffled[i + 1], shuffled[i]
+    a = _reduce_records(_stream(edges, bound=2000))
+    b = _reduce_records(_stream(shuffled, bound=2000))
+    assert a == b
+
+
+def test_on_late_attached_after_derivation_is_seen():
+    """on_late on any stream in a chain is visible to all derived streams
+    (shared holder), even when attached after the derivation."""
+    edges = [(1, 2, 10, 100), (3, 4, 5, 1500), (1, 5, 7, 800)]
+    lates = []
+    base = _stream(edges, bound=0)
+    derived = base.filter_edges(lambda s, d, v: d < 10)
+    base.on_late(lambda s, d, v, t: lates.append(len(s)))  # after deriving
+    _reduce_records(derived)
+    assert lates == [1]
+
+
+def test_bound_conflicts_with_ingestion_windows():
+    with pytest.raises(ValueError, match="event-time"):
+        StreamConfig(
+            vertex_capacity=16, ingest_window_edges=8, out_of_orderness_ms=100
+        )

@@ -218,3 +218,40 @@ def test_window_triangles_sliding():
     assert want == [1, 1, 1, 0]
     with pytest.raises(ValueError, match="multiple"):
         window_triangles(stream, 2000, slide_ms=1500)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_slice_sliding_randomized_differential(seed):
+    """Random timed streams, random k: sliding reduce records must equal the
+    brute-force per-window host recompute."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(10, 40))
+    edges = [
+        (
+            int(rng.integers(1, 8)),
+            int(rng.integers(1, 8)),
+            int(rng.integers(1, 100)),
+            int(rng.integers(0, 9000)),
+        )
+        for _ in range(n)
+    ]
+    edges.sort(key=lambda e: e[3])  # ascending event time
+    k = int(rng.integers(2, 5))
+    slide = 1000
+    cfg = StreamConfig(vertex_capacity=16, max_degree=64, batch_size=4)
+    out = (
+        EdgeStream.from_collection(edges, cfg, batch_size=4, with_time=True)
+        .slice(k * slide, EdgeDirection.OUT, slide_ms=slide)
+        .reduce_on_edges(lambda a, b: a + b)
+    )
+    got = sorted(tuple(r) for r in out.collect())
+
+    pane_ids = sorted({e[3] // slide for e in edges})
+    want = []
+    for wid in range(pane_ids[0], pane_ids[-1] + k):
+        sums = {}
+        for s, _, v, t in edges:
+            if wid - k + 1 <= t // slide <= wid:
+                sums[s] = sums.get(s, 0) + v
+        want.extend(sums.items())
+    assert got == sorted(want), (k, edges)
