@@ -11,6 +11,15 @@ from gelly_streaming_tpu.library.connected_components import (
     unshard_labels,
 )
 from gelly_streaming_tpu.library.degree_distribution import DegreeDistribution
+from gelly_streaming_tpu.library.graphsage import (
+    GraphSAGEWindows,
+    SageParams,
+    SageTrainState,
+    sage_init_train,
+    sage_train_step,
+    sage_train_step_mesh,
+    sample_pairs,
+)
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
 from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
 from gelly_streaming_tpu.library.incidence_sampling import (
@@ -38,6 +47,13 @@ __all__ = [
     "sharded_cc_fixpoint",
     "sharded_cc_round",
     "DegreeDistribution",
+    "GraphSAGEWindows",
+    "SageParams",
+    "SageTrainState",
+    "sage_init_train",
+    "sage_train_step",
+    "sage_train_step_mesh",
+    "sample_pairs",
     "IterativeConnectedComponents",
     "CentralizedWeightedMatching",
     "BroadcastTriangleCount",

@@ -20,6 +20,7 @@ from typing import Iterator, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.core.snapshot import SnapshotStream
@@ -179,3 +180,147 @@ class GraphSAGEWindows:
                 )
 
         return OutputStream(blocks_fn=blocks)
+
+
+# ---------------------------------------------------------------------------
+# Training (beyond the reference, which has no learned models at all): a full
+# unsupervised GraphSAGE training step — single-device and as a mesh step
+# whose forward rides the ring feature exchange (features stay block-sharded;
+# parameter gradients flow back through the ppermute hops and are psum'd).
+#
+# Objective: skip-gram with negative sampling over the window graph (the
+# GraphSAGE paper's unsupervised loss, eq. 1): the sage embedding z_u of each
+# keyed vertex is scored against a *context* projection c(v) = relu(X[v] @
+# w_self + bias) of one sampled neighbor (positive) and one uniform random
+# vertex (negative); loss = mean softplus(-z.c_pos) + mean softplus(z.c_neg).
+# Pair sampling is host-side and explicit (sample_pairs) so the mesh step is
+# bit-comparable to the single-device step on the same pairs.
+
+
+class SageTrainState(NamedTuple):
+    params: SageParams  # float32 masters (optimizer precision)
+    opt_state: object  # optax state pytree
+
+
+def _as_bf16(params: SageParams) -> SageParams:
+    return SageParams(*(p.astype(jnp.bfloat16) for p in params))
+
+
+def sample_pairs(rng, nbrs, valid, capacity: int):
+    """One (positive neighbor, negative vertex) pair per keyed row.
+
+    Returns device arrays (pos_ids [K], has_pos [K], neg_ids [K]): pos is a
+    uniformly sampled VALID neighbor (gumbel-argmax over the mask; rows with
+    empty neighborhoods get has_pos=False and contribute no positive term),
+    neg a uniform vertex id in [0, capacity).
+    """
+    k_pos, k_neg = jax.random.split(rng)
+    scores = jnp.where(valid, jax.random.uniform(k_pos, valid.shape), -1.0)
+    pos_idx = jnp.argmax(scores, axis=1)
+    pos_ids = jnp.take_along_axis(nbrs, pos_idx[:, None], axis=1)[:, 0]
+    has_pos = valid.any(axis=1)
+    neg_ids = jax.random.randint(k_neg, (nbrs.shape[0],), 0, capacity)
+    return pos_ids, has_pos, neg_ids
+
+
+def _context(params_b: SageParams, x):
+    return jax.nn.relu(
+        x.astype(jnp.bfloat16) @ params_b.w_self + params_b.bias
+    ).astype(jnp.float32)
+
+
+def _pair_terms(z, c_pos, c_neg, has_pos):
+    """(pos_loss_sum, pos_n, neg_loss_sum, neg_n) float32 scalars."""
+    pos_s = jnp.sum(z * c_pos, axis=-1)
+    neg_s = jnp.sum(z * c_neg, axis=-1)
+    w = has_pos.astype(jnp.float32)
+    return (
+        jnp.sum(jax.nn.softplus(-pos_s) * w),
+        jnp.sum(w),
+        jnp.sum(jax.nn.softplus(neg_s)),
+        jnp.asarray(z.shape[0], jnp.float32),
+    )
+
+
+def sage_loss(params, features, keys, nbrs, valid, pos_ids, has_pos, neg_ids):
+    """Scalar unsupervised loss on one neighborhood bucket (f32 params in,
+    bf16 MXU compute inside)."""
+    p = _as_bf16(params)
+    z = sage_kernel(p, features, keys, nbrs, valid).astype(jnp.float32)
+    t = _pair_terms(
+        z, _context(p, features[pos_ids]), _context(p, features[neg_ids]), has_pos
+    )
+    return t[0] / jnp.maximum(t[1], 1.0) + t[2] / jnp.maximum(t[3], 1.0)
+
+
+def sage_init_train(key, in_features: int, out_features: int, tx) -> SageTrainState:
+    """Float32 master params + optimizer state for the given optax ``tx``."""
+    p = init_params(key, in_features, out_features)
+    p32 = SageParams(*(x.astype(jnp.float32) for x in p))
+    return SageTrainState(p32, tx.init(p32))
+
+
+def sage_train_step(tx, state: SageTrainState, features, keys, nbrs, valid,
+                    pos_ids, has_pos, neg_ids):
+    """One optimizer step; returns (new_state, loss).  Jit-friendly with
+    ``tx`` static (functools.partial / closure)."""
+    loss, grads = jax.value_and_grad(sage_loss)(
+        state.params, features, keys, nbrs, valid, pos_ids, has_pos, neg_ids
+    )
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    return SageTrainState(optax.apply_updates(state.params, updates), opt_state), loss
+
+
+def sage_loss_mesh(params, blocks, keys, nbrs, valid, pos_ids, has_pos,
+                   neg_ids, num_shards: int):
+    """The same scalar loss with rows sharded [S, K_s, ...] and features
+    block-sharded [S, C/S, F]: the forward assembles self/neighbor rows via
+    the ring exchange and the pos/neg context rows via ring lookups, the
+    four loss terms psum across shards, and the replicated scalar matches
+    sage_loss on the concatenated rows (same pairs, same masks) within bf16
+    tolerance — the single-device kernel averages neighbors in bf16, the
+    ring path in float32.
+    Differentiating through this (shard_map + ppermute transpose) yields the
+    total parameter gradient — the mesh training step's forward/backward.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
+    from gelly_streaming_tpu.parallel.ring import ring_lookup
+
+    mesh = make_mesh(num_shards)
+    p = _as_bf16(params)
+
+    def shard_fn(pb, block, keys, nbrs, valid, pos_ids, has_pos, neg_ids):
+        block, keys, nbrs = block[0], keys[0], nbrs[0]
+        valid, pos_ids, has_pos, neg_ids = (
+            valid[0], pos_ids[0], has_pos[0], neg_ids[0]
+        )
+        z = sage_kernel_ring(
+            pb, block, keys, nbrs, valid, num_shards
+        ).astype(jnp.float32)
+        c_pos = _context(pb, ring_lookup(block, pos_ids, num_shards))
+        c_neg = _context(pb, ring_lookup(block, neg_ids, num_shards))
+        t = _pair_terms(z, c_pos, c_neg, has_pos)
+        t = jax.lax.psum(jnp.stack(t), SHARD_AXIS)
+        return t[0] / jnp.maximum(t[1], 1.0) + t[2] / jnp.maximum(t[3], 1.0)
+
+    S = P(SHARD_AXIS)
+    return shard_map(
+        shard_fn,
+        mesh,
+        in_specs=(P(), S, S, S, S, S, S, S),
+        out_specs=P(),
+    )(p, blocks, keys, nbrs, valid, pos_ids, has_pos, neg_ids)
+
+
+def sage_train_step_mesh(tx, state: SageTrainState, blocks, keys, nbrs, valid,
+                         pos_ids, has_pos, neg_ids, num_shards: int):
+    """One mesh optimizer step (params replicated, grads via the ring
+    backward); returns (new_state, loss)."""
+    loss, grads = jax.value_and_grad(sage_loss_mesh)(
+        state.params, blocks, keys, nbrs, valid, pos_ids, has_pos, neg_ids,
+        num_shards,
+    )
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    return SageTrainState(optax.apply_updates(state.params, updates), opt_state), loss
