@@ -60,6 +60,27 @@ from gelly_streaming_tpu.io.sources import file_stream, generated_stream
 DEFAULT_CFG = StreamConfig(vertex_capacity=1 << 16, max_degree=256, batch_size=1 << 12)
 
 
+def extract_flags(argv, usage: str, allowed):
+    """Split ``--name[=value]`` tokens from positionals (shared by the
+    example CLIs so their flag contract cannot diverge): returns
+    ``(positionals, {name: value-str-or-True})``; an unrecognized ``--``
+    token prints the usage line and exits 2 instead of falling through as a
+    filename."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    flags = {}
+    rest = []
+    for a in args:
+        if a.startswith("--"):
+            name, _, value = a[2:].partition("=")
+            if name not in allowed:
+                print(usage, file=sys.stderr)
+                raise SystemExit(2)
+            flags[name] = value if value else True
+        else:
+            rest.append(a)
+    return rest, flags
+
+
 def parse_argv(
     argv: Optional[List[str]], usage: str, max_positional: int
 ) -> List[str]:

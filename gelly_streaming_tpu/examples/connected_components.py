@@ -23,6 +23,7 @@ from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.examples._cli import (
     DEFAULT_CFG,
     emit,
+    extract_flags,
     input_stream,
     parse_argv,
 )
@@ -38,19 +39,19 @@ USAGE = (
 
 
 def main(argv: Optional[List[str]] = None) -> None:
-    args = parse_argv(argv, USAGE, 6)
-    use_tree = "--tree" in args
-    unbounded = next((a for a in args if a.startswith("--unbounded")), None)
-    ingest = next((a for a in args if a.startswith("--ingest-window")), None)
-    args = [a for a in args if not a.startswith("--")]
+    raw, flags = extract_flags(
+        argv, USAGE, ("tree", "unbounded", "ingest-window")
+    )
+    args = parse_argv(raw, USAGE, 3)
+    use_tree = "tree" in flags
+    unbounded = flags.get("unbounded")
+    ingest = flags.get("ingest-window")
     window_ms = int(args[2]) if len(args) > 2 else 1000
-    every = int(ingest.split("=", 1)[1]) if ingest and "=" in ingest else None
+    every = int(ingest) if ingest not in (None, True) else None
     if unbounded is not None:
         from gelly_streaming_tpu.io.sources import unbounded_generated_stream
 
-        max_batches = (
-            int(unbounded.split("=", 1)[1]) if "=" in unbounded else None
-        )
+        max_batches = int(unbounded) if unbounded is not True else None
         cfg = dataclasses.replace(
             DEFAULT_CFG, ingest_window_edges=every or 4096
         )

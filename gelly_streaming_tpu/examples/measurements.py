@@ -521,8 +521,49 @@ def measure_sage(args) -> dict:
         pane_ms.append((time.perf_counter() - t1) * 1e3)
         feat_rows += hood.keys.shape[0] * (1 + hood.nbrs.shape[1])
     device_s = sum(pane_ms) / 1e3
+    train = {}
+    if args.train_steps > 0:
+        # training throughput: jitted unsupervised steps (optax adam) on a
+        # fixed [K, D] neighborhood batch of the measured shape
+        import optax
+
+        from gelly_streaming_tpu.library import graphsage as gs
+
+        k_rows = min(4096, args.vertices)
+        keys_t = jnp.asarray(rng.integers(0, args.vertices, k_rows).astype(np.int32))
+        nbrs_t = jnp.asarray(
+            rng.integers(0, args.vertices, (k_rows, args.max_degree)).astype(np.int32)
+        )
+        valid_t = jnp.asarray(rng.random((k_rows, args.max_degree)) < 0.7)
+        tx = optax.adam(1e-2)
+        state = gs.sage_init_train(
+            jax.random.PRNGKey(args.seed), args.features, args.out_features, tx
+        )
+        pos, has, neg = gs.sample_pairs(
+            jax.random.PRNGKey(args.seed + 1), nbrs_t, valid_t, args.vertices
+        )
+        feats_j = jnp.asarray(features)
+        step = jax.jit(
+            lambda st: gs.sage_train_step(
+                tx, st, feats_j, keys_t, nbrs_t, valid_t, pos, has, neg
+            )
+        )
+        state, loss0 = step(state)  # compile + first step
+        jax.block_until_ready(loss0)
+        t2 = time.perf_counter()
+        for _ in range(args.train_steps):
+            state, loss = step(state)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t2
+        train = {
+            "train_steps_per_sec": round(args.train_steps / dt, 2),
+            "train_pairs_per_sec": round(args.train_steps * k_rows / dt, 1),
+            "train_loss_first": round(float(loss0), 4),
+            "train_loss_last": round(float(loss), 4),
+        }
     return {
         "workload": "graphsage",
+        **train,
         "edges_per_sec": round(n / wall, 1),
         "embeddings_per_sec": round(total_keys / wall, 1),
         "windows": windows,
@@ -677,6 +718,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--features", type=int, default=128)
     sp.add_argument("--out-features", type=int, default=128)
     sp.add_argument("--max-degree", type=int, default=32)
+    sp.add_argument(
+        "--train-steps", type=int, default=0,
+        help="also measure N jitted unsupervised training steps",
+    )
     sp.add_argument("--seed", type=int, default=0)
     sp = sub.add_parser("routing")
     sp.add_argument("--shards", type=int, default=8)

@@ -1,10 +1,12 @@
 """Windowed exact triangle count example
 (reference: example/WindowTriangles.java:43-171).
 
-Usage: window_triangles [input-path [output-path [window-ms]]]
+Usage: window_triangles [--slide=MS] [input-path [output-path [window-ms]]]
 Input lines are ``src dst timestamp`` (event time, as in the reference's
 event-time SimpleEdgeStream over the ITCase dataset); emits
-(triangle-count, window-max-timestamp) per window.
+(triangle-count, window-max-timestamp) per window.  ``--slide=MS`` (must
+divide window-ms) counts sliding windows — beyond the tumbling-only
+reference.
 """
 
 from __future__ import annotations
@@ -14,7 +16,12 @@ from typing import List, Optional
 import numpy as np
 
 from gelly_streaming_tpu.core.stream import EdgeStream
-from gelly_streaming_tpu.examples._cli import DEFAULT_CFG, emit, parse_argv
+from gelly_streaming_tpu.examples._cli import (
+    DEFAULT_CFG,
+    emit,
+    extract_flags,
+    parse_argv,
+)
 from gelly_streaming_tpu.io.interning import VertexInterner
 from gelly_streaming_tpu.io.sources import (
     _batched,
@@ -23,11 +30,18 @@ from gelly_streaming_tpu.io.sources import (
 )
 from gelly_streaming_tpu.library.triangles import window_triangles
 
-USAGE = "window_triangles [input-path [output-path [window-ms]]]"
+USAGE = "window_triangles [--slide=MS] [input-path [output-path [window-ms]]]"
 
 
 def main(argv: Optional[List[str]] = None) -> None:
-    args = parse_argv(argv, USAGE, 3)
+    raw, flags = extract_flags(argv, USAGE, ("slide",))
+    if flags.get("slide") is True:  # --slide without =MS
+        import sys
+
+        print(USAGE, file=sys.stderr)
+        raise SystemExit(2)
+    slide_ms = int(flags["slide"]) if "slide" in flags else None
+    args = parse_argv(raw, USAGE, 3)
     window_ms = int(args[2]) if len(args) > 2 else 400
     cfg = DEFAULT_CFG
     if args:
@@ -50,7 +64,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     else:
         stream = generated_stream(cfg, 1000, num_vertices=100)
     output = args[1] if len(args) > 1 else None
-    emit(window_triangles(stream, window_ms), output)
+    emit(window_triangles(stream, window_ms, slide_ms=slide_ms), output)
 
 
 if __name__ == "__main__":
