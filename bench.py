@@ -679,7 +679,11 @@ def main():
     # MXU projections on a representative pane (VERDICT r4 item 4: the one
     # BASELINE workload that had no bench key).  Inputs stay resident (~8 MB
     # features), so this stage costs the link almost nothing.
-    sage = {"sage_device_p50_ms": None, "sage_feature_gather_gbps": None}
+    sage = {
+        "sage_device_p50_ms": None,
+        "sage_feature_gather_gbps": None,
+        "sage_train_step_p50_ms": None,
+    }
     try:
         if os.environ.get("GELLY_BENCH_SAGE", "1") != "0":
             from gelly_streaming_tpu.library.graphsage import (
@@ -721,10 +725,42 @@ def main():
                     K * (1 + D) * F * 4 / (p50 / 1e3) / 1e9, 2
                 ),
             }
-            _PARTIAL.update(sage)
+            _PARTIAL.update(sage)  # device metrics land even if training fails
+            # one resident TRAINING step on the same shapes (unsupervised
+            # loss + adam; library/graphsage.py) — BASELINE row 5's model
+            # family has a training path, so the bench times it too
+            try:
+                import functools
+
+                import optax
+
+                from gelly_streaming_tpu.library import graphsage as gs
+
+                tx = optax.adam(1e-2)
+                t_state = gs.sage_init_train(jax.random.PRNGKey(1), F, F, tx)
+                pos_a, has_a, neg_a = gs.sample_pairs(
+                    jax.random.PRNGKey(2), nbrs_a, valid_a, 1 << 14
+                )
+                t_step = jax.jit(functools.partial(gs.sage_train_step, tx))
+                batch = (feats, keys_a, nbrs_a, valid_a, pos_a, has_a, neg_a)
+                t_state, t_loss = t_step(t_state, *batch)  # compile
+                jax.block_until_ready(t_loss)
+                t_times = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    t_state, t_loss = t_step(t_state, *batch)
+                    jax.block_until_ready(t_loss)
+                    t_times.append((time.perf_counter() - t0) * 1e3)
+                sage["sage_train_step_p50_ms"] = round(
+                    float(np.percentile(t_times, 50)), 3
+                )
+                _PARTIAL.update(sage)
+            except Exception as e:
+                print(f"sage train sub-stage skipped: {e}", file=sys.stderr)
             print(
                 f"sage pane [K={K},D={D},F={F}]: device p50 {p50:.2f} ms, "
-                f"gather >= {sage['sage_feature_gather_gbps']} GB/s",
+                f"gather >= {sage['sage_feature_gather_gbps']} GB/s, "
+                f"train step p50 {sage['sage_train_step_p50_ms']} ms",
                 file=sys.stderr,
             )
     except Exception as e:  # never fail the headline metric on the extra one

@@ -363,6 +363,14 @@ def windowed_panes(
     single dispatch point for slice() and window_triangles."""
     validate_slide(window_ms, slide_ms)
     if slide_ms and slide_ms != window_ms:
+        cfg = stream.cfg
+        if cfg.ingest_window_edges or cfg.ingest_window_ms:
+            # ingestion-mode panes are cut by arrival count/wall clock, not
+            # by slide_ms — a k derived from time knobs would be a lie
+            raise ValueError(
+                "sliding windows apply to event-time slices; this stream "
+                "cuts ingestion-time panes (ingest_window_edges/_ms)"
+            )
         return sliding_panes(
             stream_panes(stream, slide_ms), window_ms // slide_ms, slide_ms
         )

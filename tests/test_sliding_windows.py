@@ -255,3 +255,14 @@ def test_slice_sliding_randomized_differential(seed):
                 sums[s] = sums.get(s, 0) + v
         want.extend(sums.items())
     assert got == sorted(want), (k, edges)
+
+
+def test_sliding_rejected_on_ingestion_mode_streams():
+    cfg = StreamConfig(
+        vertex_capacity=16, max_degree=16, batch_size=2, ingest_window_edges=4
+    )
+    stream = EdgeStream.from_collection([(1, 2), (2, 3)], cfg)
+    with pytest.raises(ValueError, match="ingestion-time"):
+        stream.slice(2000, EdgeDirection.OUT, slide_ms=1000).reduce_on_edges(
+            lambda a, b: a + b
+        ).collect()
