@@ -24,3 +24,38 @@ def test_quickstart_flow():
     outs = [c for (c,) in stream.aggregate(ConnectedComponents(window_ms=1000))]
     rendered = str(outs[-1])
     assert "1" in rendered and "5" in rendered
+
+
+def test_quickstart_sliding_and_out_of_order():
+    timed = [(1, 2, 1.0, 100), (2, 3, 1.0, 1500), (1, 3, 1.0, 800)]
+    cfg_t = StreamConfig(vertex_capacity=1 << 10, out_of_orderness_ms=1000)
+    tstream = EdgeStream.from_collection(
+        timed, cfg_t, batch_size=1, with_time=True
+    )
+    lates = []
+    tstream.on_late(lambda s, d, v, t: lates.append(len(s)))
+    recs = sorted(
+        tuple(r)
+        for r in tstream.slice(2000, EdgeDirection.OUT, slide_ms=1000)
+        .reduce_on_edges(lambda a, b: a + b)
+        .collect()
+    )
+    # batch_size=1: the t=1500 edge arrives BEFORE the t=800 straggler, so
+    # the watermark (1500 - 1000) is live when the straggler lands — inside
+    # the bound, it still joins pane 0.  windows (k=2): 0:{p0}, 1:{p0,p1}
+    assert recs == [(1, 2.0), (1, 2.0), (2, 1.0), (2, 1.0)]
+    assert lates == []
+
+    # and with bound 0 the same stream DROPS the straggler to the late sink
+    cfg0 = StreamConfig(vertex_capacity=1 << 10)
+    s0 = EdgeStream.from_collection(timed, cfg0, batch_size=1, with_time=True)
+    lates0 = []
+    s0.on_late(lambda s, d, v, t: lates0.append(len(s)))
+    recs0 = sorted(
+        tuple(r)
+        for r in s0.slice(2000, EdgeDirection.OUT, slide_ms=1000)
+        .reduce_on_edges(lambda a, b: a + b)
+        .collect()
+    )
+    assert lates0 == [1]
+    assert recs0 == [(1, 1.0), (1, 1.0), (2, 1.0), (2, 1.0)]
