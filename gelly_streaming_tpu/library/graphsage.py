@@ -88,9 +88,62 @@ def sage_kernel_ring(params: SageParams, block, keys, nbrs, valid, num_shards):
 class GraphSAGEWindows:
     """Per-window vertex embeddings over a sliced edge stream."""
 
-    def __init__(self, params: SageParams, features):
-        self.params = params
+    def __init__(self, params, features):
+        # a single SageParams (1 layer) or a sequence (stacked layers: layer
+        # l+1 aggregates layer l's window embeddings — beyond the reference).
+        # NB SageParams is itself a (Named)tuple — test for it FIRST.
+        self.layers = (
+            [params] if isinstance(params, SageParams) else list(params)
+        )
+        if not self.layers or not all(
+            isinstance(p, SageParams) for p in self.layers
+        ):
+            raise TypeError(
+                "params must be a SageParams or a non-empty sequence of them"
+            )
+        self.params = self.layers[0]  # layer-1 view (back-compat)
         self.features = jnp.asarray(features)
+
+    def _layer_over_buckets(self, params, feats, hoods):
+        """One sage layer over a window's materialized buckets: returns
+        (keys [K], emb [K, F_out]) host arrays for the window's real rows."""
+        ks, es = [], []
+        for hood in hoods:
+            emb = sage_kernel_jit(
+                params,
+                feats,
+                jnp.asarray(hood.keys),
+                jnp.asarray(hood.nbrs),
+                jnp.asarray(hood.valid),
+            )
+            n = hood.num_keys
+            ks.append(np.asarray(hood.keys)[:n])
+            es.append(np.asarray(emb.astype(jnp.float32))[:n])
+        return np.concatenate(ks), np.concatenate(es)
+
+    def _stack_layers(self, hoods, first=None):
+        """Run the layer stack over one window's buckets.
+
+        ``first`` optionally supplies layer 1's output (e.g. from the
+        sharded plane).  Hidden layers see a per-window [C, F_l] buffer:
+        rows for the window's keyed vertices, zeros elsewhere — the window
+        defines the graph, so vertices outside it have no layer-l state.
+        With slice(ALL) every window vertex is a key, so every neighbor row
+        is populated.
+        """
+        c = self.features.shape[0]
+        keys = emb = None
+        for li, p in enumerate(self.layers):
+            if li == 0 and first is not None:
+                keys, emb = first
+                continue
+            feats = self.features
+            if li > 0:
+                h = np.zeros((c, emb.shape[1]), np.float32)
+                h[keys] = emb
+                feats = jnp.asarray(h)
+            keys, emb = self._layer_over_buckets(p, feats, hoods)
+        return keys, emb
 
     def run(self, snapshot: SnapshotStream) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yields (keys [K], embeddings [K, F_out]) per closed window.
@@ -101,28 +154,42 @@ class GraphSAGEWindows:
         the window runs on the sharded plane: features live as modulo blocks
         (one per device) and ``sage_kernel_ring`` assembles self/neighbor
         rows via the ring exchange instead of replicating X — the sharded
-        kernel finally drives the product path (VERDICT r2 missing #6)."""
+        kernel finally drives the product path (VERDICT r2 missing #6).
+
+        Stacked layers (a params sequence): layer 1 reads the raw feature
+        table — the potentially huge gather, ring-sharded on the mesh path —
+        and each deeper layer aggregates the previous layer's window
+        embeddings, a per-window [C, F_l] buffer that is orders smaller and
+        runs on one device.
+        """
+        self._check_direction(snapshot)
         if snapshot._use_mesh():
             yield from self._run_sharded(snapshot)
             return
         import itertools
 
-        for _, hoods in itertools.groupby(
+        grouped = itertools.groupby(
             snapshot._neighborhood_panes(), key=lambda h: h.pane.window_id
-        ):
-            ks, es = [], []
-            for hood in hoods:
-                emb = sage_kernel_jit(
-                    self.params,
-                    self.features,
-                    jnp.asarray(hood.keys),
-                    jnp.asarray(hood.nbrs),
-                    jnp.asarray(hood.valid),
-                )
-                n = hood.num_keys
-                ks.append(np.asarray(hood.keys)[:n])
-                es.append(np.asarray(emb.astype(jnp.float32))[:n])
-            yield np.concatenate(ks), np.concatenate(es)
+        )
+        if len(self.layers) == 1:
+            # stream bucket-by-bucket: no need to pin a window's tensors
+            for _, hoods in grouped:
+                yield self._layer_over_buckets(self.layers[0], self.features, hoods)
+            return
+        for _, hoods in grouped:
+            yield self._stack_layers(list(hoods))
+
+    def _check_direction(self, snapshot: SnapshotStream) -> None:
+        """Stacked layers need every in-window vertex keyed so hidden rows
+        exist for every neighbor — only slice(ALL) guarantees that (under
+        OUT/IN a sink/source-only vertex would contribute a zero hidden row
+        and silently dilute layer-2 means)."""
+        from gelly_streaming_tpu.core.types import EdgeDirection
+
+        if len(self.layers) > 1 and snapshot.direction != EdgeDirection.ALL:
+            raise ValueError(
+                "stacked GraphSAGE layers require slice(..., EdgeDirection.ALL)"
+            )
 
     def _sharded_state(self, s_n: int):
         """(kernel, blocks) built once per shard count: the kernel object is
@@ -152,9 +219,8 @@ class GraphSAGEWindows:
         self._sharded_cache = (s_n, kernel, blocks)
         return kernel, blocks
 
-    def _run_sharded(self, snapshot: SnapshotStream):
-        """Ring-sharded window pass: feature blocks [S, C/S, F] stay on their
-        shards; each shard's buckets gather remote rows via ppermute hops."""
+    def _sharded_layer1_windows(self, snapshot: SnapshotStream):
+        """Layer 1 on the sharded plane, one (keys, emb) pair per window."""
         kernel, blocks = self._sharded_state(snapshot._stream.cfg.num_shards)
 
         cur_wid = None
@@ -170,6 +236,35 @@ class GraphSAGEWindows:
             es.append(np.asarray(out).astype(np.float32))
         if ks:
             yield np.concatenate(ks), np.concatenate(es)
+
+    def _run_sharded(self, snapshot: SnapshotStream):
+        """Ring-sharded window pass: feature blocks [S, C/S, F] stay on their
+        shards; each shard's buckets gather remote rows via ppermute hops.
+        Stacked layers: layer 1 (the raw-feature gather) runs sharded; deeper
+        layers aggregate the window's [C, F_l] hidden buffer single-device
+        over a second, bucket-building pass of the same re-runnable stream,
+        zipped window-by-window with layer 1's output."""
+        if len(self.layers) == 1:
+            yield from self._sharded_layer1_windows(snapshot)
+            return
+        import copy
+        import itertools
+
+        # pass 2 rebuilds the window buckets on a sink-less stream clone:
+        # the layer-1 pass already delivered each late record to the user's
+        # on_late sink once; the second assignment must not re-fire it
+        s2 = copy.copy(snapshot._stream)
+        s2._late_holder = {"sink": None}
+        snap2 = SnapshotStream(
+            s2, snapshot.window_ms, snapshot.direction, snapshot.slide_ms
+        )
+        hood_groups = itertools.groupby(
+            snap2._neighborhood_panes(), key=lambda h: h.pane.window_id
+        )
+        for first, (_, hoods) in zip(
+            self._sharded_layer1_windows(snapshot), hood_groups
+        ):
+            yield self._stack_layers(list(hoods), first=first)
 
     def output(self, snapshot: SnapshotStream) -> OutputStream:
         """(vertex, embedding-norm) records — a compact observable stream."""
