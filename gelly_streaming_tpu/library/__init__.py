@@ -22,6 +22,7 @@ from gelly_streaming_tpu.library.graphsage import (
 )
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
 from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
+from gelly_streaming_tpu.library.pagerank import pagerank_windows, windowed_pagerank
 from gelly_streaming_tpu.library.incidence_sampling import (
     IncidenceRouter,
     MeshSampledTriangleCount,
@@ -56,6 +57,8 @@ __all__ = [
     "sample_pairs",
     "IterativeConnectedComponents",
     "CentralizedWeightedMatching",
+    "pagerank_windows",
+    "windowed_pagerank",
     "BroadcastTriangleCount",
     "IncidenceSamplingTriangleCount",
     "IncidenceRouter",

@@ -184,8 +184,6 @@ class GraphSAGEWindows:
         exist for every neighbor — only slice(ALL) guarantees that (under
         OUT/IN a sink/source-only vertex would contribute a zero hidden row
         and silently dilute layer-2 means)."""
-        from gelly_streaming_tpu.core.types import EdgeDirection
-
         if len(self.layers) > 1 and snapshot.direction != EdgeDirection.ALL:
             raise ValueError(
                 "stacked GraphSAGE layers require slice(..., EdgeDirection.ALL)"
@@ -250,6 +248,16 @@ class GraphSAGEWindows:
         import copy
         import itertools
 
+        if snapshot._stream.cfg.ingest_window_ms:
+            # wall-clock panes are not replay-deterministic (core/windows.py
+            # documents the same refusal for checkpointed runs): the second
+            # pane-building pass would cut different windows and the zip
+            # below would silently pair layer-1 output with foreign buckets
+            raise ValueError(
+                "stacked sharded GraphSAGE needs replay-deterministic panes; "
+                "use ingest_window_edges or event-time windows, not "
+                "ingest_window_ms"
+            )
         # pass 2 rebuilds the window buckets on a sink-less stream clone:
         # the layer-1 pass already delivered each late record to the user's
         # on_late sink once; the second assignment must not re-fire it

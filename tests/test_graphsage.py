@@ -310,3 +310,21 @@ def test_stacked_sharded_fires_late_sink_once():
         )
     )
     assert lates == [1]  # delivered exactly once, not once per pass
+
+
+def test_stacked_sharded_refuses_wall_clock_panes():
+    from gelly_streaming_tpu.library.graphsage import GraphSAGEWindows, init_params
+
+    p = init_params(jax.random.key(0), 4, 4)
+    feats = np.zeros((16, 4), np.float32)
+    cfg = StreamConfig(
+        vertex_capacity=16, max_degree=8, batch_size=2, num_shards=8,
+        ingest_window_ms=50,
+    )
+    stream = EdgeStream.from_collection([(1, 2), (2, 3)], cfg)
+    with pytest.raises(ValueError, match="replay-deterministic"):
+        list(
+            GraphSAGEWindows([p, p], feats).run(
+                stream.slice(1000, EdgeDirection.ALL)
+            )
+        )
