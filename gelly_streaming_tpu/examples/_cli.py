@@ -81,6 +81,17 @@ def extract_flags(argv, usage: str, allowed):
     return rest, flags
 
 
+def flag_value(flags, name: str, usage: str):
+    """Value of --name=VALUE, None if absent; a bare --name (no value)
+    prints usage and exits 2 — shared so every example rejects the
+    valueless form identically."""
+    v = flags.get(name)
+    if v is True:
+        print(usage, file=sys.stderr)
+        raise SystemExit(2)
+    return v
+
+
 def parse_argv(
     argv: Optional[List[str]], usage: str, max_positional: int
 ) -> List[str]:

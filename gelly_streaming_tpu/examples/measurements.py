@@ -15,13 +15,18 @@ and prints ONE JSON line of metrics.
   python -m gelly_streaming_tpu.examples.measurements spanner       [options]
   python -m gelly_streaming_tpu.examples.measurements matching      [options]
   python -m gelly_streaming_tpu.examples.measurements sage          [options]
+  python -m gelly_streaming_tpu.examples.measurements pagerank      [options]
 
 Options: --edges N --vertices C --batch B --seed S; triangles also takes
 --windows W --pane-vertices K (panes are K-vertex random graphs counted with
 the MXU kernel; reports p50/p95 per-window latency); spanner adds
 --max-degree D --k K (two-phase batch admission, reports edges/s and the
 admitted spanner size); matching reports the reference's net-runtime metric
-(CentralizedWeightedMatching.java:62-64) plus edges/s; replay drives the
+(CentralizedWeightedMatching.java:62-64) plus edges/s; sage adds
+--features F --out-features G --max-degree D --train-steps N (windowed
+GraphSAGE embedding throughput; N>0 also times jitted unsupervised training
+steps); pagerank adds --windows W --tol T (windowed PageRank edges/s,
+windows/s, device ms/iteration); replay drives the
 wire-replay CC headline (EdgeStream.from_wire) and reports replay/pack
 rates plus the encoding's bytes per edge.
 """
@@ -582,6 +587,71 @@ def measure_sage(args) -> dict:
     }
 
 
+def measure_pagerank(args) -> dict:
+    """Windowed PageRank throughput: edges/s and windows/s through the
+    product path (pane assembly -> padded scatter-add power iteration under
+    while_loop), plus per-window device iteration latency."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.pagerank import (
+        _pane_pagerank,
+        pagerank_windows,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    window_ms = 1000
+    per_w = max(1, args.edges // max(1, args.windows))
+    n = per_w * args.windows
+    src = rng.integers(0, args.vertices, n)
+    dst = rng.integers(0, args.vertices, n)
+    ts = np.repeat(np.arange(args.windows) * window_ms, per_w)
+    edges = [(int(s), int(d), 0.0, int(t)) for s, d, t in zip(src, dst, ts)]
+    cfg = StreamConfig(vertex_capacity=args.vertices, batch_size=per_w)
+
+    def run():
+        stream = EdgeStream.from_collection(
+            edges, cfg, batch_size=per_w, with_time=True
+        )
+        return sum(
+            1 for _ in pagerank_windows(stream, window_ms, tol=args.tol)
+        )
+
+    run()  # compile warmup
+    t0 = time.perf_counter()
+    windows = run()
+    wall = time.perf_counter() - t0
+
+    # device-only iteration latency on one resident pane
+    e_pad = max(1, 1 << (per_w - 1).bit_length())
+    s_a = jnp.asarray(np.resize(src[:per_w], e_pad).astype(np.int32))
+    d_a = jnp.asarray(np.resize(dst[:per_w], e_pad).astype(np.int32))
+    m_a = jnp.asarray(np.arange(e_pad) < per_w)
+    c_args = (
+        s_a, d_a, m_a, args.vertices,
+        jnp.float32(0.85), jnp.float32(args.tol), jnp.int32(100),
+    )
+    r, _, iters = _pane_pagerank(*c_args)
+    jax.block_until_ready(r)
+    t1 = time.perf_counter()
+    r, _, iters = _pane_pagerank(*c_args)
+    jax.block_until_ready(r)
+    dev_ms = (time.perf_counter() - t1) * 1e3
+    return {
+        "workload": "pagerank",
+        "edges_per_sec": round(n / wall, 1),
+        "windows_per_sec": round(windows / wall, 2),
+        "windows": windows,
+        "device_pane_ms": round(dev_ms, 3),
+        "device_iters": int(iters),
+        "device_ms_per_iter": round(dev_ms / max(int(iters), 1), 4),
+    }
+
+
 def measure_routing(args) -> dict:
     """Skew robustness of the device keyBy plane (SURVEY §7 "skewed keys"):
     route a zipf-keyed batch over the mesh with plain ``device_route`` vs
@@ -723,6 +793,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="also measure N jitted unsupervised training steps",
     )
     sp.add_argument("--seed", type=int, default=0)
+    sp = sub.add_parser("pagerank")
+    sp.add_argument("--edges", type=int, default=1 << 18)
+    sp.add_argument("--vertices", type=int, default=1 << 14)
+    sp.add_argument("--windows", type=int, default=8)
+    sp.add_argument("--tol", type=float, default=1e-8)
+    sp.add_argument("--seed", type=int, default=0)
     sp = sub.add_parser("routing")
     sp.add_argument("--shards", type=int, default=8)
     sp.add_argument("--batch", type=int, default=256, help="edges per shard")
@@ -741,6 +817,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "spanner": measure_spanner,
         "matching": measure_matching,
         "replay": measure_replay,
+        "pagerank": measure_pagerank,
         "routing": measure_routing,
         "sage": measure_sage,
     }[args.workload]

@@ -1,0 +1,45 @@
+"""Windowed PageRank example (beyond the reference's example set).
+
+Usage: pagerank [--slide=MS] [--damping=F] [input-path [output-path [window-ms]]]
+Input lines are ``src dst [timestamp]``; untimed input ranks the whole
+stream as one window.  Emits (vertex, rank) per closed window; with
+``--slide`` every sliding window of size window-ms is ranked every MS.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import (
+    DEFAULT_CFG,
+    emit,
+    extract_flags,
+    flag_value,
+    input_stream,
+    parse_argv,
+)
+from gelly_streaming_tpu.library.pagerank import windowed_pagerank
+
+USAGE = (
+    "pagerank [--slide=MS] [--damping=F] "
+    "[input-path [output-path [window-ms]]]"
+)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    raw, flags = extract_flags(argv, USAGE, ("slide", "damping"))
+    args = parse_argv(raw, USAGE, 3)
+    window_ms = int(args[2]) if len(args) > 2 else 1000
+    slide = flag_value(flags, "slide", USAGE)
+    slide_ms = int(slide) if slide else None
+    damp = flag_value(flags, "damping", USAGE)
+    damping = float(damp) if damp else 0.85
+    stream, output = input_stream(args, DEFAULT_CFG)
+    emit(
+        windowed_pagerank(stream, window_ms, slide_ms=slide_ms, damping=damping),
+        output,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ from gelly_streaming_tpu.examples._cli import (
     DEFAULT_CFG,
     emit,
     extract_flags,
+    flag_value,
     parse_argv,
 )
 from gelly_streaming_tpu.io.interning import VertexInterner
@@ -35,12 +36,8 @@ USAGE = "window_triangles [--slide=MS] [input-path [output-path [window-ms]]]"
 
 def main(argv: Optional[List[str]] = None) -> None:
     raw, flags = extract_flags(argv, USAGE, ("slide",))
-    if flags.get("slide") is True:  # --slide without =MS
-        import sys
-
-        print(USAGE, file=sys.stderr)
-        raise SystemExit(2)
-    slide_ms = int(flags["slide"]) if "slide" in flags else None
+    slide = flag_value(flags, "slide", USAGE)
+    slide_ms = int(slide) if slide else None
     args = parse_argv(raw, USAGE, 3)
     window_ms = int(args[2]) if len(args) > 2 else 400
     cfg = DEFAULT_CFG

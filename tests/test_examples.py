@@ -108,3 +108,17 @@ def test_matching_example(tmp_path):
 def test_example_usage_error():
     with pytest.raises(SystemExit):
         exact_triangle_count.main(["a", "b", "c", "d", "e"])
+
+
+def test_pagerank_example(tmp_path):
+    from gelly_streaming_tpu.examples import pagerank as ex
+
+    inp = tmp_path / "edges.txt"
+    inp.write_text("1 2\n2 3\n3 1\n3 4\n4 1\n5 1\n")
+    out = tmp_path / "out.csv"
+    ex.main([str(inp), str(out), "1000"])
+    lines = out.read_text().strip().split("\n")
+    recs = {int(l.split(",")[0]): float(l.split(",")[1]) for l in lines}
+    assert len(recs) == 5
+    assert abs(sum(recs.values()) - 1.0) < 1e-4
+    assert recs[1] == max(recs.values())

@@ -164,3 +164,20 @@ def test_replay_measurement(capsys):
     # capacity 512 << batch 1024: EF40 (~2.7 B/edge) must win over the
     # 4 B/edge width-2 fixed pack — pins the encoding selection
     assert out["bytes_per_edge"] < 3
+
+
+def test_pagerank_measurement(capsys):
+    out = _run(
+        [
+            "pagerank",
+            "--edges", "2048",
+            "--vertices", "256",
+            "--windows", "2",
+        ],
+        capsys,
+    )
+    assert out["workload"] == "pagerank"
+    assert out["windows"] == 2
+    assert out["edges_per_sec"] > 0
+    assert out["device_iters"] > 1
+    assert out["device_ms_per_iter"] > 0
