@@ -9,11 +9,28 @@ Must run before jax initializes, hence module-level in conftest.
 import os
 import sys
 
+# XLA's CPU client sizes its worker pools from the detected core count (1
+# here); with 8 virtual devices the partitions' blocking collective waits
+# can then hold every pool worker — a schedule-dependent in-process
+# DEADLOCK (observed: rare multi-minute stalls / 40 s-timeout aborts on
+# ppermute-heavy tests).  NPROC is the pool-size override the client
+# honors: 16 workers mean 8 waiting partitions can never exhaust the pool.
+os.environ.setdefault("NPROC", "16")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# 8 virtual devices on few (here: one) physical cores: a starved
+# partition thread can miss XLA's default 40 s collective rendezvous,
+# which abort()s the whole pytest process (observed intermittently on
+# the ppermute-heavy mesh tests under host load).  Starvation must be a
+# slow test, never suite death.  (Per-flag guards: never shadow a
+# user-set value with an appended duplicate.)
+if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in _flags:
+    _flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,31 @@
+"""The virtual-mesh deadlock workaround (NPROC pool override + raised XLA
+collective rendezvous timeouts) lives in BOTH tests/conftest.py and
+__graft_entry__.py — they cannot share a helper because each must run before
+ANY jax import (importing the package would pull jax).  This drift guard
+pins the two copies to the same values."""
+
+import os
+import re
+
+
+def _flags_of(path):
+    src = open(path).read()
+    vals = dict(
+        re.findall(r"--(xla_cpu_collective_call_\w+_timeout_seconds)=(\d+)", src)
+    )
+    nproc = re.search(r'setdefault\("NPROC", "?(\d+)"?\)', src)
+    vals["NPROC"] = nproc.group(1) if nproc else None
+    return vals
+
+
+def test_conftest_and_graft_entry_agree():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    a = _flags_of(os.path.join(root, "tests", "conftest.py"))
+    b = _flags_of(os.path.join(root, "__graft_entry__.py"))
+    assert a == b, (a, b)
+    assert a["NPROC"] is not None
+    assert set(a) == {
+        "NPROC",
+        "xla_cpu_collective_call_warn_stuck_timeout_seconds",
+        "xla_cpu_collective_call_terminate_timeout_seconds",
+    }
