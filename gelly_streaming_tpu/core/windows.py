@@ -341,6 +341,19 @@ def sliding_panes(
             evict(wid)
 
 
+def pad_pane_edges(pane: WindowPane):
+    """(src, dst, mask) int32/bool arrays padded to the next power of two —
+    the shared pane->fixed-shape policy for per-pane device kernels
+    (PageRank, SSSP), so successive similar panes reuse compiled steps."""
+    e = pane.num_edges
+    e_pad = max(1, 1 << (e - 1).bit_length())
+    src = np.zeros((e_pad,), np.int32)
+    dst = np.zeros((e_pad,), np.int32)
+    msk = np.zeros((e_pad,), bool)
+    src[:e], dst[:e], msk[:e] = pane.src, pane.dst, True
+    return src, dst, msk
+
+
 def validate_slide(window_ms: int, slide_ms: Optional[int]) -> None:
     """Eager check of a sliding-window spec (shared by every slide entry
     point so the contract cannot diverge)."""

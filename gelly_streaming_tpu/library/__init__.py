@@ -23,6 +23,7 @@ from gelly_streaming_tpu.library.graphsage import (
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
 from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
 from gelly_streaming_tpu.library.pagerank import pagerank_windows, windowed_pagerank
+from gelly_streaming_tpu.library.sssp import sssp_windows, windowed_sssp
 from gelly_streaming_tpu.library.incidence_sampling import (
     IncidenceRouter,
     MeshSampledTriangleCount,
@@ -59,6 +60,8 @@ __all__ = [
     "CentralizedWeightedMatching",
     "pagerank_windows",
     "windowed_pagerank",
+    "sssp_windows",
+    "windowed_sssp",
     "BroadcastTriangleCount",
     "IncidenceSamplingTriangleCount",
     "IncidenceRouter",

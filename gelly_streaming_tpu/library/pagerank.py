@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
-from gelly_streaming_tpu.core.windows import windowed_panes
+from gelly_streaming_tpu.core.windows import pad_pane_edges, windowed_panes
 
 
 @partial(jax.jit, static_argnames=("capacity",))
@@ -111,14 +111,9 @@ def pagerank_windows(
     of ``windowed_pagerank`` for callers composing further device work."""
     cfg = stream.cfg
     for pane in windowed_panes(stream, window_ms, slide_ms):
-        e = pane.num_edges
-        if e == 0:
+        if pane.num_edges == 0:
             continue
-        e_pad = max(1, 1 << (e - 1).bit_length())
-        src = np.zeros((e_pad,), np.int32)
-        dst = np.zeros((e_pad,), np.int32)
-        msk = np.zeros((e_pad,), bool)
-        src[:e], dst[:e], msk[:e] = pane.src, pane.dst, True
+        src, dst, msk = pad_pane_edges(pane)
         r, in_w, _ = _pane_pagerank(
             jnp.asarray(src),
             jnp.asarray(dst),
