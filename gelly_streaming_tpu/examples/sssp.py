@@ -1,0 +1,39 @@
+"""Windowed single-source shortest paths example (beyond the reference's
+example set).
+
+Usage: sssp [--source=V] [--slide=MS] [input-path [output-path [window-ms]]]
+Input lines are ``src dst [weight] [timestamp]``; valueless input counts
+hops.  Emits (vertex, distance) per closed window for reached vertices.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from gelly_streaming_tpu.examples._cli import (
+    DEFAULT_CFG,
+    emit,
+    extract_flags,
+    flag_value,
+    input_stream,
+    parse_argv,
+)
+from gelly_streaming_tpu.library.sssp import windowed_sssp
+
+USAGE = "sssp [--source=V] [--slide=MS] [input-path [output-path [window-ms]]]"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    raw, flags = extract_flags(argv, USAGE, ("source", "slide"))
+    args = parse_argv(raw, USAGE, 3)
+    window_ms = int(args[2]) if len(args) > 2 else 1000
+    src_flag = flag_value(flags, "source", USAGE)
+    source = int(src_flag) if src_flag else 0
+    slide = flag_value(flags, "slide", USAGE)
+    slide_ms = int(slide) if slide else None
+    stream, output = input_stream(args, DEFAULT_CFG)
+    emit(windowed_sssp(stream, source, window_ms, slide_ms=slide_ms), output)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,20 +26,23 @@ import numpy as np
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.core.windows import pad_pane_edges, windowed_panes
 
-_INF = jnp.float32(jnp.inf)
+_BIG = jnp.float32(1e30)  # unreached sentinel; big + max weight stays finite
 
 
 @partial(jax.jit, static_argnames=("capacity",))
 def _pane_sssp(src, dst, w, mask, source, capacity, max_iters):
-    """Distances [C] from ``source`` over one pane's (padded) edge list."""
-    dist0 = jnp.full((capacity,), _INF).at[source].set(0.0)
-    big = jnp.float32(3.4e38)  # inf-safe stand-in inside the scatter
+    """Distances [C] from ``source`` over one pane's (padded) edge list;
+    unreached vertices hold the ``_BIG`` sentinel (filtered by callers).
+    The whole relaxation runs in sentinel space — no per-iteration inf
+    translation; masked/padding edges contribute ``_BIG`` candidates that
+    can never win a min against a real distance."""
+    dist0 = jnp.full((capacity,), _BIG).at[source].set(0.0)
 
     def body(state):
         dist, _, it = state
-        cand = jnp.where(mask, jnp.where(jnp.isinf(dist[src]), big, dist[src]) + w, big)
-        relaxed = jnp.full((capacity,), big).at[dst].min(cand)
-        new = jnp.minimum(dist, jnp.where(relaxed >= big, _INF, relaxed))
+        cand = jnp.where(mask, dist[src] + w, _BIG)
+        relaxed = jnp.full((capacity,), _BIG).at[dst].min(cand)
+        new = jnp.minimum(dist, relaxed)
         return new, jnp.any(new < dist), it + 1
 
     def cond(state):
@@ -59,7 +62,13 @@ def sssp_windows(
     slide_ms: Optional[int] = None,
     max_iters: Optional[int] = None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-    """(vertex ids [V], distances [V]) per window, reached vertices only."""
+    """(vertex ids [V], distances [V]) per window, reached vertices only.
+
+    ``max_iters`` bounds the relaxation rounds: the default (capacity - 1)
+    always converges to exact shortest paths; a smaller value computes
+    BOUNDED-HOP distances — shortest paths using at most ``max_iters``
+    relaxation rounds, with farther vertices reported unreached (the same
+    bounded semantics as the spanner's boundedBFS)."""
     cfg = stream.cfg
     if not 0 <= source < cfg.vertex_capacity:
         # an out-of-range source would be silently dropped by the jit
@@ -75,6 +84,13 @@ def sssp_windows(
         e_pad = len(src)
         if pane.val is not None:
             leaves = jax.tree.leaves(pane.val)
+            if len(leaves) != 1 or np.ndim(leaves[0]) != 1:
+                # a multi-leaf value has no unambiguous weight — refuse
+                # loudly (same contract as distinct's _value_bits)
+                raise ValueError(
+                    "sssp needs a single scalar edge value as the weight; "
+                    f"got a {len(leaves)}-leaf value pytree"
+                )
             wts = np.asarray(leaves[0], np.float32)
             if (wts < 0).any():
                 raise ValueError("sssp requires non-negative edge weights")
@@ -93,7 +109,7 @@ def sssp_windows(
             jnp.int32(iters),
         )
         d = np.asarray(dist)
-        vids = np.nonzero(np.isfinite(d))[0]
+        vids = np.nonzero(d < 1e30)[0]
         yield vids, d[vids]
 
 
@@ -108,7 +124,9 @@ def windowed_sssp(
 
     Directionality is as-given (relaxation follows src -> dst); pre-apply
     ``stream.undirected()`` for symmetric distances.  Unreached vertices
-    emit nothing.
+    emit nothing; with a user ``max_iters`` below the window's path depth
+    that includes vertices farther than the bound (bounded-hop semantics,
+    see sssp_windows).
     """
 
     def blocks() -> Iterator[RecordBlock]:

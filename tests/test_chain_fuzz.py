@@ -121,3 +121,83 @@ def test_random_chain_with_ingestion_panes_matches_global(seed):
             np.asarray(plain[-1][0].seen),
             err_msg=f"chain={names} panes={variant}",
         )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_chain_sliding_reduce_matches_host(seed):
+    """Random transform chain -> sliding slice -> reduce, differentially
+    against a host model applying the same chain then windowing by hand."""
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    rng = np.random.default_rng(seed + 100)
+    n = int(rng.integers(30, 120))
+    src = rng.integers(0, CAP, n)
+    dst = rng.integers(0, CAP, n)
+    val = rng.integers(1, 20, n)
+    tim = np.sort(rng.integers(0, 5000, n))
+    k = int(rng.integers(2, 4))
+    batch = int(rng.choice([4, 8]))
+
+    # host-modellable chain ops over (s, d, v) tuples
+    host_ops = {
+        "rev": lambda es: [(d, s, v) for s, d, v in es],
+        "fe_mod": lambda es: [(s, d, v) for s, d, v in es if (s + d) % 3 != 0],
+        "fe_ne": lambda es: [(s, d, v) for s, d, v in es if s != d],
+    }
+    stream_ops = {
+        "rev": lambda st: st.reverse(),
+        "fe_mod": lambda st: st.filter_edges(lambda a, b, v: (a + b) % 3 != 0),
+        "fe_ne": lambda st: st.filter_edges(lambda a, b, v: a != b),
+    }
+    names = [
+        list(host_ops)[i]
+        for i in rng.choice(len(host_ops), rng.integers(0, 3))
+    ]
+
+    cfg = StreamConfig(vertex_capacity=CAP, batch_size=batch)
+    stream = EdgeStream.from_collection(
+        [
+            (int(s), int(d), int(v), int(t))
+            for s, d, v, t in zip(src, dst, val, tim)
+        ],
+        cfg,
+        batch_size=batch,
+        with_time=True,
+    )
+    for nm in names:
+        stream = stream_ops[nm](stream)
+    got = sorted(
+        tuple(r)
+        for r in stream.slice(k * 1000, EdgeDirection.OUT, slide_ms=1000)
+        .reduce_on_edges(lambda a, b: a + b)
+        .collect()
+    )
+
+    # host model: chain, then sliding windows over 1000ms panes
+    es = [
+        (int(s), int(d), int(v), int(t))
+        for s, d, v, t in zip(src, dst, val, tim)
+    ]
+    chained = [(s, d, v) for s, d, v, _ in es]
+    times = [t for _, _, _, t in es]
+    for nm in names:
+        # reverse keeps positions; filters drop positions (and their times)
+        if nm == "rev":
+            chained = host_ops[nm](chained)
+        else:
+            if nm == "fe_mod":
+                sel = [(s + d) % 3 != 0 for s, d, v in chained]
+            else:
+                sel = [s != d for s, d, v in chained]
+            chained = [e for e, m in zip(chained, sel) if m]
+            times = [t for t, m in zip(times, sel) if m]
+    pane_of = [t // 1000 for t in times]
+    want = []
+    if pane_of:
+        for wid in range(min(pane_of), max(pane_of) + k):
+            sums = {}
+            for (s, d, v), p in zip(chained, pane_of):
+                if wid - k + 1 <= p <= wid:
+                    sums[s] = sums.get(s, 0) + v
+            want.extend(sums.items())
+    assert got == sorted(want), (seed, names, k)

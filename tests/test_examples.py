@@ -122,3 +122,17 @@ def test_pagerank_example(tmp_path):
     assert len(recs) == 5
     assert abs(sum(recs.values()) - 1.0) < 1e-4
     assert recs[1] == max(recs.values())
+
+
+def test_sssp_example(tmp_path):
+    from gelly_streaming_tpu.examples import sssp as ex
+
+    inp = tmp_path / "edges.txt"
+    inp.write_text("0 1 4\n0 2 1\n2 1 2\n1 3 1\n2 3 5\n")
+    out = tmp_path / "out.csv"
+    ex.main(["--source=0", str(inp), str(out), "1000"])
+    recs = {
+        int(l.split(",")[0]): float(l.split(",")[1])
+        for l in out.read_text().strip().split("\n")
+    }
+    assert recs == {0: 0.0, 1: 3.0, 2: 1.0, 3: 4.0}

@@ -103,3 +103,20 @@ def test_out_of_range_source_rejected():
     stream = EdgeStream.from_collection([(0, 1)], CFG)
     with pytest.raises(ValueError, match="outside"):
         list(sssp_windows(stream, 40, 1000))
+
+
+def test_multi_leaf_values_rejected():
+    edges = [(0, 1, 2.0)]
+    stream = EdgeStream.from_collection(edges, CFG).map_edges(
+        lambda s, d, v: {"a": v, "b": v}
+    )
+    with pytest.raises(ValueError, match="single scalar"):
+        list(sssp_windows(stream, 0, 1000))
+
+
+def test_bounded_hop_semantics():
+    # chain 0->1->2->3; max_iters=2 reaches exactly 2 hops
+    edges = [(0, 1, 1.0), (1, 2, 1.0), (2, 3, 1.0)]
+    stream = EdgeStream.from_collection(edges, CFG)
+    got = _records(windowed_sssp(stream, 0, 1000, max_iters=2))
+    assert got == {0: 0.0, 1: 1.0, 2: 2.0}  # vertex 3 beyond the bound
