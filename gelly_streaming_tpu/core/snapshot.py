@@ -53,7 +53,7 @@ class Neighborhoods:
         self.num_keys = num_keys  # real key count (rows beyond are padding)
 
 
-_build_buckets_j = jax.jit(nbh_ops.build_buckets)
+_build_buckets_j = nbh_ops.build_buckets_jit
 
 
 class SnapshotStream:

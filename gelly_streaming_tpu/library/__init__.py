@@ -22,6 +22,7 @@ from gelly_streaming_tpu.library.graphsage import (
 )
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
 from gelly_streaming_tpu.library.matching import CentralizedWeightedMatching
+from gelly_streaming_tpu.library.kcore import core_numbers_windows, windowed_kcore
 from gelly_streaming_tpu.library.pagerank import pagerank_windows, windowed_pagerank
 from gelly_streaming_tpu.library.sssp import sssp_windows, windowed_sssp
 from gelly_streaming_tpu.library.incidence_sampling import (
@@ -58,6 +59,8 @@ __all__ = [
     "sample_pairs",
     "IterativeConnectedComponents",
     "CentralizedWeightedMatching",
+    "core_numbers_windows",
+    "windowed_kcore",
     "pagerank_windows",
     "windowed_pagerank",
     "sssp_windows",

@@ -128,3 +128,10 @@ def build_buckets(src, dst, val, mask) -> List[NeighborhoodBucket]:
             )
         )
     return out
+
+
+# the shared jitted instance (one compile cache for every caller:
+# core/snapshot.py pane builds, library/kcore.py, ...)
+import jax as _jax
+
+build_buckets_jit = _jax.jit(build_buckets)

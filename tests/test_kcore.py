@@ -1,0 +1,112 @@
+"""Windowed k-core (beyond the reference library): h-index fixed point
+matches host peeling on known and random graphs; dedupe/self-loop contract;
+sliding windows compose."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.kcore import core_numbers_windows, windowed_kcore
+
+CFG = StreamConfig(vertex_capacity=32, max_degree=16, batch_size=8)
+
+
+def _host_cores(edges):
+    """Classic peeling: repeatedly remove the min-degree vertex."""
+    adj = {}
+    for s, d in edges:
+        if s == d:
+            continue
+        adj.setdefault(s, set()).add(d)
+        adj.setdefault(d, set()).add(s)
+    cores = {}
+    deg = {v: len(ns) for v, ns in adj.items()}
+    k = 0
+    while deg:
+        v = min(deg, key=deg.get)
+        k = max(k, deg[v])
+        cores[v] = k
+        for u in adj[v]:
+            if u in deg and u != v:
+                deg[u] -= 1
+        del deg[v]
+        for u in adj[v]:
+            adj.get(u, set()).discard(v)
+    return cores
+
+
+def _records(out):
+    return {int(v): int(c) for v, c in out.collect()}
+
+
+def test_clique_and_pendant():
+    # 4-clique (core 3) with a pendant vertex (core 1)
+    edges = [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3), (3, 4)]
+    got = _records(windowed_kcore(EdgeStream.from_collection(edges, CFG), 1000))
+    assert got == {0: 3, 1: 3, 2: 3, 3: 3, 4: 1}
+
+
+def test_cycle_is_two_core():
+    edges = [(0, 1), (1, 2), (2, 3), (3, 0)]
+    got = _records(windowed_kcore(EdgeStream.from_collection(edges, CFG), 1000))
+    assert got == {0: 2, 1: 2, 2: 2, 3: 2}
+
+
+def test_tree_is_one_core():
+    edges = [(0, 1), (0, 2), (1, 3), (1, 4)]
+    got = _records(windowed_kcore(EdgeStream.from_collection(edges, CFG), 1000))
+    assert got == {v: 1 for v in range(5)}
+
+
+def test_duplicates_and_self_loops_ignored():
+    edges = [(0, 1), (1, 0), (0, 1), (2, 2), (1, 2), (2, 0)]
+    got = _records(windowed_kcore(EdgeStream.from_collection(edges, CFG), 1000))
+    # triangle 0-1-2 regardless of dupes/self-loop
+    assert got == {0: 2, 1: 2, 2: 2}
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_graphs_match_host_peeling(seed):
+    rng = np.random.default_rng(seed)
+    edges = [
+        (int(rng.integers(0, 24)), int(rng.integers(0, 24))) for _ in range(60)
+    ]
+    got = _records(windowed_kcore(EdgeStream.from_collection(edges, CFG), 1000))
+    assert got == _host_cores(edges)
+
+
+def test_sliding_windows_compose():
+    timed = [
+        (0, 1, 0, 100),
+        (1, 2, 0, 200),
+        (2, 0, 0, 300),   # triangle in pane 0
+        (3, 4, 0, 1100),  # lone edge in pane 1
+    ]
+    stream = EdgeStream.from_collection(timed, CFG, batch_size=2, with_time=True)
+    wins = [
+        dict(zip(v.tolist(), c.tolist()))
+        for v, c in core_numbers_windows(stream, 2000, slide_ms=1000)
+    ]
+    # windows: 0:{p0} 1:{p0,p1} 2:{p1}
+    assert wins[0] == {0: 2, 1: 2, 2: 2}
+    assert wins[1] == {0: 2, 1: 2, 2: 2, 3: 1, 4: 1}
+    assert wins[2] == {3: 1, 4: 1}
+
+
+def test_long_path_converges_exactly():
+    """Corrections propagate one hop per round: a long path needs ~n/2
+    rounds; the default must iterate to the exact fixed point (all cores 1)."""
+    cfg = StreamConfig(vertex_capacity=1024, max_degree=8, batch_size=512)
+    n = 600
+    edges = [(i, i + 1) for i in range(n - 1)]
+    got = _records(windowed_kcore(EdgeStream.from_collection(edges, cfg), 1000))
+    assert got == {v: 1 for v in range(n)}
+
+
+def test_exhausted_max_rounds_raises():
+    cfg = StreamConfig(vertex_capacity=1024, max_degree=8, batch_size=512)
+    edges = [(i, i + 1) for i in range(399)]
+    stream = EdgeStream.from_collection(edges, cfg)
+    with pytest.raises(RuntimeError, match="converge"):
+        list(core_numbers_windows(stream, 1000, max_rounds=3))
