@@ -178,3 +178,36 @@ def test_bound_conflicts_with_ingestion_windows():
         StreamConfig(
             vertex_capacity=16, ingest_window_edges=8, out_of_orderness_ms=100
         )
+
+
+def test_aggregate_cc_with_out_of_order_stream():
+    """The aggregation path shares stream_panes: an out-of-order timed
+    stream folds the same components as its sorted equivalent when the
+    shuffle stays inside the bound."""
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+
+    sorted_edges = [
+        (1, 2, 0, 100),
+        (3, 4, 0, 700),
+        (2, 3, 0, 1400),
+        (5, 6, 0, 2200),
+    ]
+    shuffled = [sorted_edges[1], sorted_edges[0]] + sorted_edges[2:]
+
+    def components(edges):
+        cfg = StreamConfig(
+            vertex_capacity=16, batch_size=1, out_of_orderness_ms=1000
+        )
+        stream = EdgeStream.from_collection(
+            edges, cfg, batch_size=1, with_time=True
+        )
+        (ds,) = stream.aggregate(ConnectedComponents(window_ms=1000)).collect()[-1]
+        return ds.components()
+
+    assert components(shuffled) == components(sorted_edges)
+    # and the final summary is the full merge: {1,2,3,4} and {5,6}
+    comps = components(shuffled)
+    members = sorted(tuple(sorted(v)) for v in comps.values())
+    assert members == [(1, 2, 3, 4), (5, 6)]
