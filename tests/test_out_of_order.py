@@ -197,17 +197,12 @@ def test_aggregate_cc_with_out_of_order_stream():
     shuffled = [sorted_edges[1], sorted_edges[0]] + sorted_edges[2:]
 
     def components(edges):
-        cfg = StreamConfig(
-            vertex_capacity=16, batch_size=1, out_of_orderness_ms=1000
-        )
-        stream = EdgeStream.from_collection(
-            edges, cfg, batch_size=1, with_time=True
-        )
+        stream = _stream(edges, bound=1000, batch_size=1)
         (ds,) = stream.aggregate(ConnectedComponents(window_ms=1000)).collect()[-1]
         return ds.components()
 
-    assert components(shuffled) == components(sorted_edges)
+    got = components(shuffled)
+    assert got == components(sorted_edges)
     # and the final summary is the full merge: {1,2,3,4} and {5,6}
-    comps = components(shuffled)
-    members = sorted(tuple(sorted(v)) for v in comps.values())
+    members = sorted(tuple(sorted(v)) for v in got.values())
     assert members == [(1, 2, 3, 4), (5, 6)]
