@@ -16,14 +16,14 @@ target (the reversed stream); ALL keys both endpoints of each edge
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, Optional, Tuple
+from typing import Callable, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from gelly_streaming_tpu.core.output import OutputStream
-from gelly_streaming_tpu.core.types import EdgeBatch, EdgeDirection
+from gelly_streaming_tpu.core.types import EdgeDirection
 from gelly_streaming_tpu.core.windows import (
     WindowPane,
     validate_slide,
