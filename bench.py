@@ -742,13 +742,13 @@ def main():
                     jax.random.PRNGKey(2), nbrs_a, valid_a, 1 << 14
                 )
                 t_step = jax.jit(functools.partial(gs.sage_train_step, tx))
-                batch = (feats, keys_a, nbrs_a, valid_a, pos_a, has_a, neg_a)
-                t_state, t_loss = t_step(t_state, *batch)  # compile
+                t_batch = (feats, keys_a, nbrs_a, valid_a, pos_a, has_a, neg_a)
+                t_state, t_loss = t_step(t_state, *t_batch)  # compile
                 jax.block_until_ready(t_loss)
                 t_times = []
                 for _ in range(5):
                     t0 = time.perf_counter()
-                    t_state, t_loss = t_step(t_state, *batch)
+                    t_state, t_loss = t_step(t_state, *t_batch)
                     jax.block_until_ready(t_loss)
                     t_times.append((time.perf_counter() - t0) * 1e3)
                 sage["sage_train_step_p50_ms"] = round(
