@@ -16,6 +16,8 @@ and prints ONE JSON line of metrics.
   python -m gelly_streaming_tpu.examples.measurements matching      [options]
   python -m gelly_streaming_tpu.examples.measurements sage          [options]
   python -m gelly_streaming_tpu.examples.measurements pagerank      [options]
+  python -m gelly_streaming_tpu.examples.measurements sssp          [options]
+  python -m gelly_streaming_tpu.examples.measurements kcore         [options]
 
 Options: --edges N --vertices C --batch B --seed S; triangles also takes
 --windows W --pane-vertices K (panes are K-vertex random graphs counted with
@@ -652,6 +654,67 @@ def measure_pagerank(args) -> dict:
     }
 
 
+def _measure_windowed_algo(args, name: str, run_windows, weighted: bool) -> dict:
+    """Shared harness for the per-window fixed-point algorithms (sssp,
+    kcore): vectorized timed-edge generation, compile warmup, one timed
+    pass; ``run_windows(stream, window_ms)`` yields once per window."""
+    import time
+
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    rng = np.random.default_rng(args.seed)
+    window_ms = 1000
+    per_w = max(1, args.edges // max(1, args.windows))
+    n = per_w * args.windows
+    src = rng.integers(0, args.vertices, n)
+    dst = rng.integers(0, args.vertices, n)
+    w = rng.integers(1, 10, n) if weighted else np.zeros(n, np.int64)
+    ts = np.repeat(np.arange(args.windows) * window_ms, per_w)
+    edges = [
+        (int(a), int(b), float(c) if weighted else 0, int(t))
+        for a, b, c, t in zip(src, dst, w, ts)
+    ]
+    cfg = StreamConfig(vertex_capacity=args.vertices, batch_size=per_w)
+
+    def run():
+        stream = EdgeStream.from_collection(
+            edges, cfg, batch_size=per_w, with_time=True
+        )
+        return sum(1 for _ in run_windows(stream, window_ms))
+
+    run()  # compile warmup
+    t0 = time.perf_counter()
+    windows = run()
+    wall = time.perf_counter() - t0
+    return {
+        "workload": name,
+        "edges_per_sec": round(n / wall, 1),
+        "windows_per_sec": round(windows / wall, 2),
+        "windows": windows,
+    }
+
+
+def measure_sssp(args) -> dict:
+    """Windowed SSSP throughput: edges/s and windows/s through the product
+    path (pane assembly -> scatter-min Bellman-Ford under while_loop)."""
+    from gelly_streaming_tpu.library.sssp import sssp_windows
+
+    return _measure_windowed_algo(
+        args, "sssp", lambda st, wm: sssp_windows(st, 0, wm), weighted=True
+    )
+
+
+def measure_kcore(args) -> dict:
+    """Windowed k-core throughput: edges/s and windows/s through the
+    product path (dedupe -> bucketed neighborhoods -> h-index fixpoint)."""
+    from gelly_streaming_tpu.library.kcore import core_numbers_windows
+
+    return _measure_windowed_algo(
+        args, "kcore", core_numbers_windows, weighted=False
+    )
+
+
 def measure_routing(args) -> dict:
     """Skew robustness of the device keyBy plane (SURVEY §7 "skewed keys"):
     route a zipf-keyed batch over the mesh with plain ``device_route`` vs
@@ -799,6 +862,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--windows", type=int, default=8)
     sp.add_argument("--tol", type=float, default=1e-8)
     sp.add_argument("--seed", type=int, default=0)
+    for name in ("sssp", "kcore"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--edges", type=int, default=1 << 16)
+        sp.add_argument("--vertices", type=int, default=1 << 12)
+        sp.add_argument("--windows", type=int, default=8)
+        sp.add_argument("--seed", type=int, default=0)
     sp = sub.add_parser("routing")
     sp.add_argument("--shards", type=int, default=8)
     sp.add_argument("--batch", type=int, default=256, help="edges per shard")
@@ -818,6 +887,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         "matching": measure_matching,
         "replay": measure_replay,
         "pagerank": measure_pagerank,
+        "sssp": measure_sssp,
+        "kcore": measure_kcore,
         "routing": measure_routing,
         "sage": measure_sage,
     }[args.workload]

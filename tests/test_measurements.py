@@ -181,3 +181,14 @@ def test_pagerank_measurement(capsys):
     assert out["edges_per_sec"] > 0
     assert out["device_iters"] > 1
     assert out["device_ms_per_iter"] > 0
+
+
+@pytest.mark.parametrize("workload", ["sssp", "kcore"])
+def test_sssp_kcore_measurements(capsys, workload):
+    out = _run(
+        [workload, "--edges", "1024", "--vertices", "128", "--windows", "2"],
+        capsys,
+    )
+    assert out["workload"] == workload
+    assert out["windows"] == 2
+    assert out["edges_per_sec"] > 0
