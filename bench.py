@@ -87,7 +87,16 @@ GELLY_BENCH_CPU_TRIALS (5), GELLY_BENCH_SETTLE_MAX (per-gate settle bound,
 default 120 s), GELLY_BENCH_WAIT_BUDGET (total settle seconds across the
 drive, default 300), GELLY_BENCH_E2E_EDGES (default 4M — long enough that
 the link's ~40-65 ms result RTT no longer floors the rate, ~20 MB of pair40
-wire so a post-headline refill still covers it).
+wire so a post-headline refill still covers it), GELLY_BENCH_SUPERBATCH
+(coalesce K wire batches per device dispatch on the drive; 0 = off),
+GELLY_BENCH_INGEST (=0 skips the pre-device ingest-scaling sub-benchmark),
+GELLY_INGEST_WORKERS (host ingest worker pool size; default = usable cores).
+
+Host-ingest keys (ISSUE 1): ``ingest_pack_eps_by_workers`` /
+``ingest_parse_eps_by_workers`` map worker count -> pre-device edges/s with
+``ingest_*_speedup_at_4plus`` the multi-worker multiple over one thread;
+``cache_recompiles`` counts XLA recompiles across 100 same-shape windows
+after warmup (target 0 — the executable cache, core/compile_cache.py).
 """
 
 import ctypes
@@ -442,6 +451,72 @@ def _flink_proxy(src, dst, capacity: int, trials: int, sample: int):
     )
 
 
+def _ingest_scaling(src, dst, capacity: int, sample: int, batch: int):
+    """Pre-device host ingest throughput by worker count (no JAX anywhere).
+
+    Measures the two CPU-bound ingest stages the parallel worker pool
+    (io/ingest.py) shards: text PARSING (native byte-range workers over a
+    generated edge file) and wire PACKING (arena rows packed in parallel).
+    Reports edges/s per worker count plus the multi-worker speedup over the
+    single-threaded path — the ISSUE-1 acceptance number.  Worker counts
+    beyond the host's usable cores still run (threads timeshare); the
+    per-count report makes the scaling curve — and any core-bound plateau —
+    visible instead of hiding it in one number.
+    """
+    from gelly_streaming_tpu.io import ingest, wire
+
+    cores = ingest.resolve_workers(0)
+    counts = sorted({1, 2, 4, max(4, cores)})
+    width = wire.width_for_capacity(capacity)
+    s = src[:sample]
+    d = dst[:sample]
+
+    pack_eps = {}
+    for w in counts:
+        t0 = time.perf_counter()
+        bufs, _ = ingest.parallel_pack_stream(s, d, batch, width, workers=w)
+        pack_eps[str(w)] = round(len(s) / (time.perf_counter() - t0), 1)
+        del bufs
+
+    parse_eps = {}
+    parse_sample = min(sample, 4 << 20)
+    path = None
+    try:
+        import tempfile as _tf
+
+        fd, path = _tf.mkstemp(suffix=".edges")
+        with os.fdopen(fd, "w") as f:
+            f.write(
+                "\n".join(
+                    f"{a} {b}"
+                    for a, b in zip(
+                        s[:parse_sample].tolist(), d[:parse_sample].tolist()
+                    )
+                )
+                + "\n"
+            )
+        for w in counts:
+            t0 = time.perf_counter()
+            out = ingest.parse_edge_file_parallel(path, workers=w)
+            parse_eps[str(w)] = round(len(out[0]) / (time.perf_counter() - t0), 1)
+    finally:
+        if path:
+            os.unlink(path)
+
+    best = max((k for k in pack_eps if int(k) >= 4), key=int)
+    return {
+        "ingest_workers_available": cores,
+        "ingest_pack_eps_by_workers": pack_eps,
+        "ingest_parse_eps_by_workers": parse_eps,
+        "ingest_pack_speedup_at_4plus": round(
+            pack_eps[best] / pack_eps["1"], 2
+        ),
+        "ingest_parse_speedup_at_4plus": round(
+            parse_eps[best] / parse_eps["1"], 2
+        ),
+    }
+
+
 def main():
     num_edges = int(os.environ.get("GELLY_BENCH_EDGES", 50 << 21))
     capacity = int(os.environ.get("GELLY_BENCH_VERTICES", 1 << 20))
@@ -497,6 +572,28 @@ def main():
             file=sys.stderr,
         )
 
+    # ---- ingest-throughput sub-benchmark (pre-device, pure host) -----------
+    ingest_stats = {}
+    try:
+        if os.environ.get("GELLY_BENCH_INGEST", "1") != "0":
+            ingest_sample = min(num_edges, 8 << 20)
+            ingest_stats = _ingest_scaling(
+                src, dst, capacity, ingest_sample, min(batch, 1 << 20)
+            )
+            _PARTIAL.update(ingest_stats)
+            print(
+                f"ingest scaling (pre-device): pack "
+                f"{ingest_stats['ingest_pack_eps_by_workers']} eps, parse "
+                f"{ingest_stats['ingest_parse_eps_by_workers']} eps, "
+                f"pack speedup x{ingest_stats['ingest_pack_speedup_at_4plus']}"
+                f" / parse x{ingest_stats['ingest_parse_speedup_at_4plus']} "
+                f"at 4+ workers on {ingest_stats['ingest_workers_available']} "
+                "usable cores",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"ingest scaling skipped: {e}", file=sys.stderr)
+
     cancel_init_watchdog = _watchdog(
         float(os.environ.get("GELLY_BENCH_INIT_TIMEOUT", 600)),
         "device backend init",
@@ -523,7 +620,13 @@ def main():
     # (the ckpt_eps stage); keeping it on the ONE cfg lets that stage reuse
     # the headline's compiled fused step
     cfg = StreamConfig(
-        vertex_capacity=capacity, batch_size=batch, wire_checkpoint_batches=2
+        vertex_capacity=capacity,
+        batch_size=batch,
+        wire_checkpoint_batches=2,
+        # opt-in superbatch dispatch coalescing for the drive (results are
+        # identical either way — tests/test_superbatch.py); default 0 keeps
+        # the headline comparable with earlier rounds
+        superbatch=int(os.environ.get("GELLY_BENCH_SUPERBATCH", "0")),
     )
     agg = ConnectedComponents()
     # CC's fold is order-free, so the replay stream ships whichever legal
@@ -549,6 +652,52 @@ def main():
     out0 = prefix.aggregate(agg)
     assert agg._wire_eligible(prefix), "bench must ride the product fast path"
     out0.collect()
+
+    # ---- executable cache: zero recompiles across 100 same-shape windows ---
+    # The ISSUE-1 acceptance guard, measured in-process: a small wire stream
+    # emitting one running window per batch, run once to compile and once
+    # metered — re-created stream AND descriptor, so any unstable kernel
+    # identity would recompile and the counter would catch it.
+    from gelly_streaming_tpu.core import compile_cache
+
+    cache_guard = {}
+    try:
+        bs_small = 1 << 12
+        cap_small = min(capacity, 1 << 16)
+        cfg_cc = StreamConfig(
+            vertex_capacity=cap_small,
+            batch_size=bs_small,
+            ingest_window_edges=bs_small,
+        )
+        s_small = (src[: 100 * bs_small] % cap_small).astype(np.int32)
+        d_small = (dst[: 100 * bs_small] % cap_small).astype(np.int32)
+
+        def run_100_windows():
+            return (
+                EdgeStream.from_arrays(s_small, d_small, cfg_cc)
+                .aggregate(ConnectedComponents())
+                .collect()
+            )
+
+        run_100_windows()  # compiles land here
+        compile_cache.reset_stats()
+        n_windows = len(run_100_windows())
+        cstats = compile_cache.stats()
+        cache_guard = {
+            "cache_windows": n_windows,
+            "cache_recompiles": cstats["recompiles"],
+            "cache_compiles_after_warm": cstats["compiles"],
+            "cache_compile_time_s": cstats["compile_time_s"],
+        }
+        _PARTIAL.update(cache_guard)
+        print(
+            f"executable cache: {n_windows} same-shape windows, "
+            f"{cstats['compiles']} compiles / {cstats['recompiles']} "
+            "recompiles after warmup (target: 0)",
+            file=sys.stderr,
+        )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"executable cache guard skipped: {e}", file=sys.stderr)
 
     # ---- device-only fold rate + roofline (needs a fresh link: even
     # dispatch RPCs get ~100ms+ latency once the tunnel throttles, so this
@@ -956,6 +1105,8 @@ def main():
                     for key, v in tri.items()
                 },
                 **sage,
+                **ingest_stats,
+                **cache_guard,
             }
         )
     )

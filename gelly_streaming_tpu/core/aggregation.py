@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.core.windows import WindowPane, stream_panes
@@ -54,6 +55,17 @@ class SummaryAggregation:
     """
 
     transient_state: bool = False
+    # Executable-cache identity.  The streaming kernels (update / combine /
+    # the fused wire steps) are traced from bound methods, so by default
+    # each descriptor INSTANCE owns its executables (``cache_token`` is the
+    # instance).  Descriptors whose update/combine/initial_state are pure
+    # functions of (class, cfg) — most library descriptors — override this
+    # to the class, so re-created descriptors (a fresh
+    # ``ConnectedComponents()`` per stream, window, or bench chunk) share
+    # compiled executables instead of retracing.
+    @property
+    def cache_token(self):
+        return self
     # True when transform(fold(edges)) is invariant under reordering edges
     # within (and across) micro-batches — e.g. union-find CC, parity
     # union-find bipartiteness.  Order-free descriptors may legally ride the
@@ -122,15 +134,15 @@ class SummaryAggregation:
 
     @property
     def _update_j(self):
-        if not hasattr(self, "_update_cache"):
-            self._update_cache = jax.jit(self.update)
-        return self._update_cache
+        return compile_cache.cached_jit(
+            ("agg_update", self.cache_token), lambda: self.update
+        )
 
     @property
     def _combine_j(self):
-        if not hasattr(self, "_combine_cache"):
-            self._combine_cache = jax.jit(self.combine)
-        return self._combine_cache
+        return compile_cache.cached_jit(
+            ("agg_combine", self.cache_token), lambda: self.combine
+        )
 
     # -- packed-wire fast path ------------------------------------------------
     #
@@ -196,22 +208,11 @@ class SummaryAggregation:
         )
         return self._wire_emit_every(cfg, batch) >= 0
 
-    def _wire_fused_step(self, stream, batch: int, width):
-        """Jitted (stage-states, summary), wire-buffer -> carry step, cached so
-        repeated runs over the same stream/shape reuse the compiled kernel."""
-        # Key on the stages tuple itself (strong ref), not id(): an id can be
-        # reused after GC, silently resurrecting a kernel compiled for a
-        # DIFFERENT stream's stages (e.g. another filter predicate).
-        key = (stream._stages, stream.cfg, batch, str(width), "wire")
-        cache = getattr(self, "_wire_step_cache", None)
-        if cache is None:
-            cache = self._wire_step_cache = {}
-        if key in cache:
-            return cache[key]
+    def _make_wire_tail(self, stages):
+        """The shared (carry, src, dst, mask) -> carry fold tail: stream
+        stages then updateFun, traced identically by the per-batch fused
+        step, the padded-tail step, and the superbatch scan body."""
         from gelly_streaming_tpu.core.types import EdgeBatch
-        from gelly_streaming_tpu.io import wire
-
-        stages = stream._stages
 
         def tail(carry, src, dst, mask):
             states, summary = carry
@@ -223,18 +224,79 @@ class SummaryAggregation:
             summary = self.update(summary, b.src, b.dst, b.val, b.mask)
             return (tuple(out_states), summary)
 
-        def fused(carry, buf):
-            s, d = wire.unpack_edges(buf, batch, width)
-            return tail(carry, s, d, jnp.ones((batch,), bool))
+        return tail
 
-        entry = (
-            jax.jit(fused, donate_argnums=0),
-            jax.jit(tail, donate_argnums=0),
+    def _wire_fused_step(self, stream, batch: int, width):
+        """Jitted (stage-states, summary), wire-buffer -> carry step.
+
+        Executables live in the process-global compile cache keyed on
+        (descriptor cache token, stages, cfg, batch, width) — so repeated
+        runs, re-created streams, AND re-created descriptors with a
+        class-level ``cache_token`` all share one compiled kernel.  Keys use
+        the stages tuple itself (strong ref), not id(): an id can be reused
+        after GC, silently resurrecting a kernel compiled for a DIFFERENT
+        stream's stages (e.g. another filter predicate).
+        """
+        from gelly_streaming_tpu.io import wire
+
+        token = self.cache_token
+        stages = stream._stages
+        key_tail = (stream._stages, stream.cfg, batch, str(width))
+
+        def make_fused():
+            tail = self._make_wire_tail(stages)
+
+            def fused(carry, buf):
+                s, d = wire.unpack_edges(buf, batch, width)
+                return tail(carry, s, d, jnp.ones((batch,), bool))
+
+            return fused
+
+        return (
+            compile_cache.cached_jit(
+                ("wire_fused", token) + key_tail, make_fused, donate_argnums=0
+            ),
+            compile_cache.cached_jit(
+                ("wire_tail", token, stages),
+                lambda: self._make_wire_tail(stages),
+                donate_argnums=0,
+            ),
         )
-        while len(cache) >= 8:  # bound: evict oldest (compiled fns are heavy)
-            cache.pop(next(iter(cache)))
-        cache[key] = entry
-        return entry
+
+    def _wire_scan_step(self, stream, batch: int, width, group: int):
+        """Superbatch step: fold ``group`` stacked wire buffers in ONE
+        device call via ``lax.scan`` over the same per-batch tail the fused
+        step traces — bit-identical to ``group`` sequential dispatches, at
+        1/group of the dispatch overhead.  Compiled once per bucketed group
+        size (power-of-two sizes only, see plan_superbatch_groups)."""
+        from gelly_streaming_tpu.io import wire
+
+        token = self.cache_token
+        stages = stream._stages
+        key = (
+            "wire_scan",
+            token,
+            stages,
+            stream.cfg,
+            batch,
+            str(width),
+            group,
+        )
+
+        def make_scan():
+            tail = self._make_wire_tail(stages)
+
+            def scan_fused(carry, bufs):  # bufs: uint8[group, nbytes]
+                def body(c, buf):
+                    s, d = wire.unpack_edges(buf, batch, width)
+                    return tail(c, s, d, jnp.ones((batch,), bool)), None
+
+                carry, _ = jax.lax.scan(body, carry, bufs)
+                return carry
+
+            return scan_fused
+
+        return compile_cache.cached_jit(key, make_scan, donate_argnums=0)
 
     def _wire_width(self, cfg: StreamConfig, batch: Optional[int] = None):
         """Resolve the wire encoding for this descriptor + config.
@@ -492,36 +554,79 @@ class SummaryAggregation:
         # a single end-of-stream pane, which the final emission covers
         emit_every = max(0, self._wire_emit_every(cfg, batch))
 
+        # superbatch coalescing: dispatch groups of consecutive full batches
+        # in ONE device call each.  Group sizes are powers of two <= K and
+        # never cross an emission or snapshot boundary, so the observable
+        # record/recovery sequence is identical to per-batch dispatch.
+        from gelly_streaming_tpu.core.stream import plan_superbatch_groups
+
+        boundaries = []
+        if emit_every:
+            boundaries.append((emit_every, start_batch))
+        if checkpoint_path and every:
+            boundaries.append((every, 0))
+        groups = plan_superbatch_groups(
+            n_full - start_batch, max(1, cfg.superbatch), boundaries
+        )
+
         def device_buffers():
+            """(group size, device buffer) pairs: ``uint8[nbytes]`` for
+            size-1 groups (the historical per-batch path), ``uint8[g,
+            nbytes]`` stacked groups otherwise.  Packing/stacking runs on
+            the Prefetcher's background thread; the transfer on its second
+            — one transfer per GROUP, so superbatching also amortizes
+            per-transfer overhead."""
+            offsets = []
+            o = 0
+            for g in groups:
+                offsets.append((o, g))
+                o += g
             if packed is not None:
-                with wire.Prefetcher(
-                    bufs[start_batch:],
-                    lambda b: (None, b),
-                    depth=cfg.prefetch_depth,
-                ) as pf:
-                    for _, b in pf:
-                        yield b
-                return
 
-            def full_batches():
-                for i in range(start_batch, n_full):
-                    yield (
-                        src[i * batch : (i + 1) * batch],
-                        dst[i * batch : (i + 1) * batch],
+                def prep(item):
+                    o, g = item
+                    if g == 1:
+                        return 1, bufs[start_batch + o]
+                    return g, np.stack(bufs[start_batch + o : start_batch + o + g])
+
+            else:
+                from gelly_streaming_tpu.io import ingest as ingest_mod
+
+                workers = ingest_mod.resolve_workers(cfg.ingest_workers)
+                nbytes = wire.wire_nbytes(batch, width)
+
+                def prep(item):
+                    o, g = item
+                    i0 = start_batch + o
+                    if g == 1:
+                        return 1, wire.pack_edges(
+                            src[i0 * batch : (i0 + 1) * batch],
+                            dst[i0 * batch : (i0 + 1) * batch],
+                            width,
+                        )
+                    # pack straight into the group arena (the transfer
+                    # layout): no re-copy between pack and device_put
+                    arena = np.empty((g, nbytes), np.uint8)
+                    ingest_mod.pack_rows_into(
+                        src, dst, i0, g, batch, width, arena, workers
                     )
+                    return g, arena
 
-            with wire.WirePrefetcher(
-                full_batches(), width, depth=cfg.prefetch_depth
-            ) as pf:
-                for b, _ in pf:
-                    yield b
+            with wire.Prefetcher(offsets, prep, depth=cfg.prefetch_depth) as pf:
+                yield from pf
 
         pending_final = True
         try:
-            for i, buf in enumerate(device_buffers()):
-                carry = fused(carry, buf)
-                absolute = start_batch + i + 1
-                if emit_every and absolute % emit_every == 0:
+            pos = start_batch
+            for g, dev in device_buffers():
+                if g == 1:
+                    carry = fused(carry, dev)
+                else:
+                    carry = self._wire_scan_step(stream, batch, width, g)(
+                        carry, dev
+                    )
+                pos += g
+                if emit_every and pos % emit_every == 0:
                     # the donated carry IS the running merged summary
                     # (Merger semantics): emit the pane's running record
                     # without leaving the fast path.  CLONE first — the next
@@ -531,12 +636,12 @@ class SummaryAggregation:
                     yield out if isinstance(out, tuple) else (out,)
                     # a stream ending exactly on a pane boundary with no
                     # tail has nothing further to emit
-                    pending_final = absolute != n_full or tail_pair is not None
-                since_snap += 1
+                    pending_final = pos != n_full or tail_pair is not None
+                since_snap += g
                 if checkpoint_path and every and since_snap >= every:
                     # the snapshot clones the carry on device BEFORE the next
                     # fused call donates it away
-                    snapshot(start_batch + i + 1, False, carry)
+                    snapshot(pos, False, carry)
                     since_snap = 0
             if tail_pair is not None:
                 rem = len(tail_pair[0])
@@ -643,6 +748,29 @@ class SummaryAggregation:
         window_ms = self.window_ms or cfg.window_ms
         n_parts = self._num_partitions(cfg)
 
+        if cfg.superbatch > 1 and n_parts == 1:
+            # superbatch the TIME plane: up to K closed panes
+            # (core/windows.group_panes) fold in ONE vmapped device call
+            # over a row-per-window layout; the shared Merger loop still
+            # merges/emits/checkpoints per window, so the record sequence
+            # and recovery semantics are identical to per-pane dispatch.
+            def records_sb() -> Iterator[tuple]:
+                skip_through, skip_global = self._restored_position(
+                    cfg, checkpoint_path, restore
+                )
+                return self._merge_loop(
+                    cfg,
+                    self._superpane_folds(
+                        stream, window_ms, skip_through, skip_global
+                    ),
+                    lambda summary: summary,
+                    checkpoint_path,
+                    restore,
+                    unwrap=True,
+                )
+
+            return OutputStream(records_sb)
+
         def fold_pane(pane: WindowPane):
             partials = []
             for part in range(n_parts):
@@ -689,6 +817,120 @@ class SummaryAggregation:
             )
 
         return OutputStream(records)
+
+    def _restored_position(self, cfg, checkpoint_path, restore):
+        """(last folded window id, global pane done) from a windowed-layout
+        snapshot — for gating pane prefetch/fold work ahead of the merge
+        loop, which re-reads the position itself and remains the source of
+        truth.  (-1, False) when there is nothing to restore."""
+        if not (checkpoint_path and restore):
+            return -1, False
+        from gelly_streaming_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_state,
+        )
+
+        if not checkpoint_exists(checkpoint_path):
+            return -1, False
+        try:
+            snap = load_state(checkpoint_path, self._checkpoint_like(cfg))
+        except ValueError:
+            return -1, False  # legacy layout: merge loop sorts it out
+        return int(snap["last_window"]), bool(snap["global_done"])
+
+    def _superpane_fold_fn(self, cfg: StreamConfig, has_val: bool):
+        """Compiled K-window fold: ONE dispatch produces every coalesced
+        window's partial summary via a vmap over per-window edge rows.
+
+        The row layout ([K, E_max]: one padded row per window) keeps the
+        dispatch's total work at K * E_max ~= the sum of the pane sizes for
+        balanced windows — NOT K times the concatenated run, which a
+        mask-per-window fold over the flat [E_total] layout would cost."""
+        token = self.cache_token
+
+        def make():
+            def fold(src_k, dst_k, val_k, mask_k):
+                def one(s, d, v, m):
+                    return self.update(self.initial_state(cfg), s, d, v, m)
+
+                if val_k is None:
+                    return jax.vmap(lambda s, d, m: one(s, d, None, m))(
+                        src_k, dst_k, mask_k
+                    )
+                return jax.vmap(one)(src_k, dst_k, val_k, mask_k)
+
+            return fold
+
+        return compile_cache.cached_jit(
+            ("superpane_fold", token, cfg, has_val), make
+        )
+
+    def _superpane_folds(
+        self, stream, window_ms: int, skip_through: int = -1, skip_global: bool = False
+    ):
+        """(pane, partial summary) pairs with up to ``cfg.superbatch``
+        consecutive panes folded per device dispatch.
+
+        The per-window partial equals the per-pane path's fold exactly: the
+        update kernel sees that window's edges (arrival order preserved)
+        with padding masked out.  Both row count and row length bucket to
+        powers of two (at most log2(K)+1 x shape-bucket compiled variants);
+        rows past the group's real panes are fully masked and their
+        initial-state outputs discarded.
+
+        ``skip_through``/``skip_global`` gate RESTORED positions: panes a
+        checkpoint already folded are dropped here without any device work
+        (the merge loop would discard them unfolded anyway — the per-pane
+        path never folds them either, and recovery must not pay a full
+        re-fold of the pre-crash stream).
+        """
+        from gelly_streaming_tpu.core.windows import group_panes
+
+        cfg = stream.cfg
+        live = (
+            p
+            for p in stream_panes(stream, window_ms)
+            if not (
+                (0 <= p.window_id <= skip_through)
+                or (p.window_id == -1 and skip_global)
+            )
+        )
+        for panes in group_panes(live, cfg.superbatch):
+            k = len(panes)
+            rows = max(1, 1 << (k - 1).bit_length())  # pow2 bucket, <= K
+            e_max = max(p.num_edges for p in panes)
+            e_pad = max(1, 1 << (e_max - 1).bit_length())
+            src_k = np.zeros((rows, e_pad), np.int32)
+            dst_k = np.zeros((rows, e_pad), np.int32)
+            mask_k = np.zeros((rows, e_pad), bool)
+            val_k = None
+            if any(p.val is not None for p in panes):
+                proto = next(p.val for p in panes if p.val is not None)
+                val_k = jax.tree.map(
+                    lambda a: np.zeros((rows, e_pad) + a.shape[1:], a.dtype),
+                    proto,
+                )
+            for i, pane in enumerate(panes):
+                n = pane.num_edges
+                src_k[i, :n] = pane.src
+                dst_k[i, :n] = pane.dst
+                mask_k[i, :n] = True
+                if val_k is not None and pane.val is not None:
+
+                    def fill(buf, a):
+                        buf[i, : len(a)] = a
+                        return buf
+
+                    val_k = jax.tree.map(fill, val_k, pane.val)
+            fold = self._superpane_fold_fn(cfg, val_k is not None)
+            states = fold(
+                jnp.asarray(src_k),
+                jnp.asarray(dst_k),
+                None if val_k is None else jax.tree.map(jnp.asarray, val_k),
+                jnp.asarray(mask_k),
+            )
+            for i, pane in enumerate(panes):
+                yield pane, jax.tree.map(lambda a, i=i: a[i], states)
 
     def _mesh_runner(self, cfg: StreamConfig) -> "MeshAggregationRunner":
         """Cached sharded runner for cfg.num_shards (compiled steps persist)."""
@@ -1432,23 +1674,10 @@ class MeshAggregationRunner:
         return finish(carry)
 
     def _restored_position(self, cfg, checkpoint_path, restore):
-        """(last folded window id, global pane done) from a snapshot, for
-        gating the pane prefetcher — folding position itself is re-read by
-        the shared merge loop, which remains the source of truth."""
-        if not (checkpoint_path and restore):
-            return -1, False
-        from gelly_streaming_tpu.utils.checkpoint import (
-            checkpoint_exists,
-            load_state,
-        )
-
-        if not checkpoint_exists(checkpoint_path):
-            return -1, False
-        try:
-            snap = load_state(checkpoint_path, self.agg._checkpoint_like(cfg))
-        except ValueError:
-            return -1, False  # legacy layout: merge loop sorts it out
-        return int(snap["last_window"]), bool(snap["global_done"])
+        """(last folded window id, global pane done) — shared reader on the
+        descriptor (SummaryAggregation._restored_position); the merge loop
+        remains the source of truth for folding position."""
+        return self.agg._restored_position(cfg, checkpoint_path, restore)
 
     def _pane_cap(self, total: int) -> int:
         per = -(-max(total, 1) // self.num_shards)  # ceil, >= 1

@@ -64,6 +64,19 @@ class StreamConfig:
     # pipeline, as the reference's two ctors do).
     ingest_window_edges: int = 0
     ingest_window_ms: int = 0
+    # Superbatch dispatch coalescing: fold up to this many prefetched
+    # micro-batches (wire fast path) or closed panes (windowed paths) into
+    # ONE device call, amortizing the per-dispatch Python/runtime overhead
+    # that dominates once the device is ~100x faster than the host feeding
+    # it.  Groups are cut to power-of-two bucket sizes and never cross an
+    # emission or snapshot boundary, so results and recovery semantics are
+    # bit-identical to per-batch dispatch (pinned by tests/test_superbatch).
+    # 0/1 = off (per-batch dispatch, the historical behavior).
+    superbatch: int = 0
+    # Host ingest worker count for parallel parsing/packing (io/ingest.py).
+    # 0 = auto: the GELLY_INGEST_WORKERS env var when set, else the
+    # process's usable core count.  1 = single-threaded.
+    ingest_workers: int = 0
     # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
     # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
     # values trail the watermark behind max seen time by the bound, holding
@@ -95,6 +108,10 @@ class StreamConfig:
             )
         if self.wire_checkpoint_batches < 0:
             raise ValueError("wire_checkpoint_batches must be >= 0")
+        if self.superbatch < 0:
+            raise ValueError("superbatch must be >= 0")
+        if self.ingest_workers < 0:
+            raise ValueError("ingest_workers must be >= 0")
         if self.vertex_capacity <= 0:
             raise ValueError("vertex_capacity must be positive")
         if self.num_shards <= 0:

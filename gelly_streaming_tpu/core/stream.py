@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import NULL, OutputStream, RecordBlock
 from gelly_streaming_tpu.core.types import EdgeBatch, EdgeDirection
@@ -142,6 +143,64 @@ class _DistinctStage(Stage):
             table, vtable, batch.src, batch.dst, bits, batch.mask
         )
         return (table, vtable), batch.replace(mask=is_new)
+
+
+class _FanoutLateHolder:
+    """Late-sink holder for ``union()``: one logical sink spanning the
+    unioned chain AND both input chains.
+
+    ``on_late``'s contract is "one sink per transform chain"; a union joins
+    two chains, so a sink attached anywhere — either input (before or after
+    the union) or the unioned stream itself — must be seen by every pane
+    assignment over any of the three chains.  Reads fall through to the
+    parents; writes fan out to them (the unioned stream's consumers read
+    through this holder, the inputs' consumers read their own holders).
+    """
+
+    def __init__(self, *parents):
+        self._parents = parents
+        self._own = {"sink": None}
+
+    def __getitem__(self, key):
+        if self._own[key] is not None:
+            return self._own[key]
+        for parent in self._parents:
+            value = parent[key]
+            if value is not None:
+                return value
+        return None
+
+    def __setitem__(self, key, value):
+        self._own[key] = value
+        for parent in self._parents:
+            parent[key] = value
+
+
+def plan_superbatch_groups(n: int, k: int, boundaries=()) -> List[int]:
+    """Split ``n`` sequential unit batches into superbatch dispatch groups.
+
+    Group sizes are powers of two <= ``k`` — a small bucketed set of
+    compiled shapes (at most log2(k)+1 distinct scan lengths) — and no
+    group crosses a boundary: each entry of ``boundaries`` is a
+    ``(modulus, offset)`` pair marking batch indices ``i`` where
+    ``(i + offset) % modulus == 0`` must START a fresh group (emission and
+    snapshot points, so coalescing never changes what a consumer observes).
+    Returns group sizes summing to ``n``; ``k <= 1`` degenerates to
+    per-batch dispatch.
+    """
+    if k <= 1 or n <= 0:
+        return [1] * max(n, 0)
+    groups: List[int] = []
+    i = 0
+    while i < n:
+        limit = min(n - i, k)
+        for mod, off in boundaries:
+            if mod:
+                limit = min(limit, mod - ((i + off) % mod))
+        g = 1 << (max(limit, 1).bit_length() - 1)  # largest pow2 <= limit
+        groups.append(g)
+        i += g
+    return groups
 
 
 # ---------------------------------------------------------------------------
@@ -482,21 +541,32 @@ class EdgeStream:
             merged_valued = True if (left._valued or right._valued) else None
         else:
             merged_valued = left._valued or right._valued
-        return EdgeStream(factory, self.cfg, valued=merged_valued)
+        out = EdgeStream(factory, self.cfg, valued=merged_valued)
+        # one logical late sink across the union AND both input chains: an
+        # on_late attached to either input (before or after this call) is
+        # seen downstream of the union, and a sink attached to the union
+        # fans out to both input chains (on_late's shared-chain contract)
+        out._late_holder = _FanoutLateHolder(left._late_holder, right._late_holder)
+        return out
 
     # ---- execution ----------------------------------------------------------
 
     def _compiled_step(self):
         stages = self._stages
 
-        def step(states, batch):
-            out_states = []
-            for stage, st in zip(stages, states):
-                st, batch = stage.apply(st, batch)
-                out_states.append(st)
-            return tuple(out_states), batch
+        def build():
+            def step(states, batch):
+                out_states = []
+                for stage, st in zip(stages, states):
+                    st, batch = stage.apply(st, batch)
+                    out_states.append(st)
+                return tuple(out_states), batch
 
-        return jax.jit(step)
+            return step
+
+        # keyed by the stages tuple: every stream over the same stage chain
+        # (including stage-less re-created sources) shares the executable
+        return compile_cache.cached_jit(("pipeline_step", stages), build)
 
     def batches(self) -> Iterator[EdgeBatch]:
         """Run the pipeline, yielding transformed micro-batches."""
@@ -506,7 +576,7 @@ class EdgeStream:
             states, out = step(states, batch)
             yield out
 
-    def _kernel_stream(self, init_fn, kernel) -> Iterator:
+    def _kernel_stream(self, init_fn, kernel, kernel_key=None) -> Iterator:
         """Run a terminal op's kernel fused with the pipeline stages.
 
         ``kernel(op_state, EdgeBatch) -> (op_state, outs)`` with ``outs`` a
@@ -524,15 +594,15 @@ class EdgeStream:
         from gelly_streaming_tpu.io import wire as _wire_mod
 
         yield from _wire_mod.prefetch_to_host(
-            self._kernel_stream_device(init_fn, kernel),
+            self._kernel_stream_device(init_fn, kernel, kernel_key),
             depth=self.cfg.prefetch_depth,
         )
 
-    def _kernel_stream_device(self, init_fn, kernel) -> Iterator:
+    def _kernel_stream_device(self, init_fn, kernel, kernel_key=None) -> Iterator:
         """`_kernel_stream`'s device plane: yields per-batch DEVICE outs."""
         cfg = self.cfg
         stages = self._stages
-        step_j, wire_j = self._kernel_step_jits(kernel)
+        step_j, wire_j = self._kernel_step_jits(kernel, kernel_key)
 
         # Committed placement: without it the first call (uncommitted fresh
         # arrays) and later calls (committed step outputs) hit different jit
@@ -573,49 +643,59 @@ class EdgeStream:
             carry, outs = step_j(carry, tail)
             yield outs
 
-    def _kernel_step_jits(self, kernel):
+    def _kernel_step_jits(self, kernel, kernel_key=None):
         """Jitted (plain, wire) step functions for a terminal-op kernel.
 
-        Cached per kernel object (one per OutputStream) so re-consuming an
-        OutputStream reuses compiled executables instead of recompiling
-        (seconds per run on TPU).  The cache is bounded: entries beyond the
-        cap evict oldest-first.
+        Executables live in the process-global compile cache
+        (core/compile_cache.py): the key is ``kernel_key`` when the caller
+        supplies a stable kernel identity (the built-in property streams do
+        — re-created streams over equal stage chains then NEVER retrace),
+        falling back to the kernel object itself (per-OutputStream reuse,
+        the historical behavior).
         """
-        cache = getattr(self, "_kstream_cache", None)
-        if cache is None:
-            cache = self._kstream_cache = {}
-        if kernel in cache:
-            return cache[kernel]
         from gelly_streaming_tpu.io import wire
 
         stages = self._stages
+        identity = kernel_key if kernel_key is not None else kernel
 
-        def step(carry, batch):
-            states, op_state = carry
-            out_states = []
-            for stage, st in zip(stages, states):
-                st, batch = stage.apply(st, batch)
-                out_states.append(st)
-            op_state, outs = kernel(op_state, batch)
-            return (tuple(out_states), op_state), outs
+        def make_step():
+            def step(carry, batch):
+                states, op_state = carry
+                out_states = []
+                for stage, st in zip(stages, states):
+                    st, batch = stage.apply(st, batch)
+                    out_states.append(st)
+                op_state, outs = kernel(op_state, batch)
+                return (tuple(out_states), op_state), outs
 
-        def wire_step(carry, buf, bs, width):
-            s, d = wire.unpack_edges(buf, bs, width)
-            # keep the byte-unpack expression out of downstream gather/scatter
-            # fusions (see _interleave_endpoints: ~7x TPU compile blowup)
-            s, d = jax.lax.optimization_barrier((s, d))
-            return step(
-                carry, EdgeBatch(src=s, dst=d, mask=jnp.ones((bs,), bool))
-            )
+            return step
 
-        entry = (
-            jax.jit(step),
-            jax.jit(wire_step, static_argnums=(2, 3), donate_argnums=0),
+        def make_wire_step():
+            step = make_step()
+
+            def wire_step(carry, buf, bs, width):
+                s, d = wire.unpack_edges(buf, bs, width)
+                # keep the byte-unpack expression out of downstream
+                # gather/scatter fusions (see _interleave_endpoints: ~7x TPU
+                # compile blowup)
+                s, d = jax.lax.optimization_barrier((s, d))
+                return step(
+                    carry, EdgeBatch(src=s, dst=d, mask=jnp.ones((bs,), bool))
+                )
+
+            return wire_step
+
+        return (
+            compile_cache.cached_jit(
+                ("kernel_step", stages, identity), make_step
+            ),
+            compile_cache.cached_jit(
+                ("kernel_wire_step", stages, identity),
+                make_wire_step,
+                static_argnums=(2, 3),
+                donate_argnums=0,
+            ),
         )
-        while len(cache) >= 8:
-            cache.pop(next(iter(cache)))
-        cache[kernel] = entry
-        return entry
 
     def collect_edges(self) -> List[tuple]:
         out: List[tuple] = []
@@ -642,7 +722,7 @@ class EdgeStream:
             return seen, (v, new)
 
         def blocks():
-            for v, new in self._kernel_stream(init, kernel):
+            for v, new in self._kernel_stream(init, kernel, ("vertices",)):
                 idx = np.nonzero(new)[0]
                 yield RecordBlock((v[idx], NULL))
 
@@ -702,7 +782,9 @@ class EdgeStream:
         def blocks():
             # _kernel_stream pipelines the downloads (async copies overlap
             # later batches' compute); outs arrive as numpy
-            for outs in self._kernel_stream(init, kernel):
+            for outs in self._kernel_stream(
+                init, kernel, ("degrees", direction, packed_ok)
+            ):
                 if packed_ok:
                     packed, maskbits = outs
                     ids, vals, m = wire_mod.unpack_records48(
@@ -732,7 +814,7 @@ class EdgeStream:
             return seen, (running, new)
 
         def blocks():
-            for running, new in self._kernel_stream(init, kernel):
+            for running, new in self._kernel_stream(init, kernel, ("nvertices",)):
                 idx = np.nonzero(new)[0]
                 yield RecordBlock((running[idx],))
 
@@ -750,7 +832,7 @@ class EdgeStream:
             return total + batch.num_valid(), (running, batch.mask)
 
         def blocks():
-            for running, m in self._kernel_stream(init, kernel):
+            for running, m in self._kernel_stream(init, kernel, ("nedges",)):
                 idx = np.nonzero(m)[0]
                 yield RecordBlock((running[idx],))
 
@@ -802,7 +884,13 @@ class EdgeStream:
             )
             return state, flat_keys, out, out_mask
 
-        kernel = jax.jit(kernel)
+        # the kernel's traced behavior is fully determined by the two user
+        # callables, so equal (expand, update) pairs share the executable
+        # across re-created streams
+        kernel = compile_cache.cached_jit(
+            ("keyed_aggregate", edge_expand, vertex_update),
+            lambda fn=kernel: fn,
+        )
 
         def chunks():
             state = state_init(cfg)
@@ -854,7 +942,9 @@ class EdgeStream:
         (always, when emit_on_change=False).
         """
         cfg = self.cfg
-        update_j = jax.jit(update)
+        update_j = compile_cache.cached_jit(
+            ("global_aggregate", update), lambda: update
+        )
 
         def records():
             state = initial_state(cfg)
@@ -906,7 +996,9 @@ class EdgeStream:
             )
             return table, rows_sorted, deg
 
-        kernel = jax.jit(kernel)
+        kernel = compile_cache.cached_jit(
+            ("build_neighborhood",), lambda fn=kernel: fn
+        )
 
         def blocks():
             table = neighbors.init_table(cfg.vertex_capacity, cfg.max_degree)

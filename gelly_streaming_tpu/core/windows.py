@@ -16,7 +16,7 @@ over a 7-edge collection).
 
 from __future__ import annotations
 
-from typing import Iterator, NamedTuple, Optional
+from typing import Iterator, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -165,7 +165,10 @@ def assign_tumbling_windows(
             continue
         wids = time // window_ms
         if watermark is not None:
-            late = (wids + 1) * window_ms <= watermark
+            # a record is late iff its window already fired: watermark has
+            # passed the window's maxTimestamp (end - 1), Flink's trigger
+            # boundary — see the close condition below
+            late = (wids + 1) * window_ms - 1 <= watermark
             if late.any():
                 if late_sink is not None:
                     import jax
@@ -192,10 +195,14 @@ def assign_tumbling_windows(
         new_watermark = int(time.max()) - out_of_orderness_ms
         if watermark is None or new_watermark > watermark:
             watermark = new_watermark
+            # fire at watermark >= maxTimestamp = end - 1 (Flink's
+            # TumblingEventTimeWindows trigger boundary): a window whose
+            # last possible record sits exactly at maxTimestamp closes the
+            # tick the watermark reaches it, not one tick later
             for wid in [
                 w
                 for w in panes.open_ids()
-                if 0 <= w and (w + 1) * window_ms <= watermark
+                if 0 <= w and (w + 1) * window_ms - 1 <= watermark
             ]:
                 yield panes.close(wid)
 
@@ -339,6 +346,110 @@ def sliding_panes(
             if out is not None:
                 yield out
             evict(wid)
+
+
+class SuperPane(NamedTuple):
+    """Up to K consecutive closed panes coalesced for ONE device dispatch.
+
+    Pane boundaries are preserved via PER-EDGE window ids (``wid``), not
+    separate dispatches: a consumer folds the concatenated edge run once and
+    recovers each window's contribution by masking ``wid == window_ids[k]``.
+    Arrays are padded to a power-of-two bucket so successive superpanes hit
+    a small set of compiled shapes (mask False marks padding; padded ``wid``
+    rows carry -2, which is never a real window id — real ids are >= -1).
+    """
+
+    panes: Tuple[WindowPane, ...]  # constituents, ascending window order
+    src: np.ndarray  # [E_pad] int32
+    dst: np.ndarray  # [E_pad] int32
+    val: Optional[object]  # pytree of [E_pad, ...] arrays, or None
+    wid: np.ndarray  # [E_pad] int32 per-edge window id (-2 on padding)
+    mask: np.ndarray  # [E_pad] bool
+    window_ids: np.ndarray  # [k] int32, the panes' window ids
+
+
+def _assemble_superpane(panes) -> SuperPane:
+    import jax
+
+    # window ids ride int32 device columns (the framework's time plane is
+    # int32 ms end to end — EdgeBatch refuses epoch-scale timestamps, so
+    # event-time ids always fit); fail loudly rather than wrap if a pathological
+    # ingestion-time stream ever outruns the range
+    if any(not (-2 < p.window_id <= np.iinfo(np.int32).max) for p in panes):
+        raise ValueError(
+            "superbatch window ids must fit int32 (rebase event timestamps "
+            "to stream-relative ms, as EdgeBatch requires)"
+        )
+    e = sum(p.num_edges for p in panes)
+    e_pad = max(1, 1 << (e - 1).bit_length()) if e else 1
+    src = np.zeros((e_pad,), np.int32)
+    dst = np.zeros((e_pad,), np.int32)
+    wid = np.full((e_pad,), -2, np.int32)
+    mask = np.zeros((e_pad,), bool)
+    o = 0
+    for p in panes:
+        n = p.num_edges
+        src[o : o + n] = p.src
+        dst[o : o + n] = p.dst
+        wid[o : o + n] = p.window_id
+        mask[o : o + n] = True
+        o += n
+    val = None
+    if any(p.val is not None for p in panes):
+
+        def cat(*leaves):
+            flat = np.concatenate(leaves)
+            out = np.zeros((e_pad,) + flat.shape[1:], flat.dtype)
+            out[: len(flat)] = flat
+            return out
+
+        val = jax.tree.map(cat, *[p.val for p in panes])
+    return SuperPane(
+        panes=tuple(panes),
+        src=src,
+        dst=dst,
+        val=val,
+        wid=wid,
+        mask=mask,
+        window_ids=np.array([p.window_id for p in panes], np.int32),
+    )
+
+
+def group_panes(panes: Iterator[WindowPane], k: int, keep_empty: bool = False):
+    """Groups of up to ``k`` consecutive closed panes (as lists).
+
+    The grouping primitive under superbatch dispatch: consumers that build
+    their own device layout (the aggregation fold's [K, E] per-window rows,
+    the triangles vmapped counter) iterate this directly and pay NO
+    assembly copy; ``coalesce_panes`` below materializes the flat SuperPane
+    view on top of it.  Panes with no edges are dropped by default (the
+    per-pane aggregation consumers skip them the same way); consumers that
+    emit a record per pane regardless (window triangles) pass
+    ``keep_empty=True``.
+    """
+    k = max(1, k)
+    buf = []
+    for pane in panes:
+        if pane.num_edges == 0 and not keep_empty:
+            continue
+        buf.append(pane)
+        if len(buf) == k:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def coalesce_panes(panes: Iterator[WindowPane], k: int) -> Iterator[SuperPane]:
+    """Group up to ``k`` consecutive non-empty closed panes into SuperPanes.
+
+    The superbatch form of the time plane: per-dispatch overhead amortizes
+    over ``k`` windows while window identity rides the per-edge ``wid``
+    column (pane boundaries as data, not dispatches); ``k <= 1``
+    degenerates to one pane per superpane.
+    """
+    for group in group_panes(panes, k):
+        yield _assemble_superpane(group)
 
 
 def pad_pane_edges(pane: WindowPane):

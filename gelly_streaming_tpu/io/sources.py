@@ -20,13 +20,22 @@ from gelly_streaming_tpu.io.interning import IdentityInterner, VertexInterner
 from gelly_streaming_tpu.utils.native import load_ingest_lib
 
 
-def parse_edge_file(path: str):
+def parse_edge_file(path: str, workers: int = 1):
     """Parse an edge-list file into host arrays.
 
     Returns (src i64, dst i64, val f64 | None, time i64 | None, sign i32 | None).
     Format per line: ``src dst [value|+|-] [timestamp]`` with space/tab/comma
     separators and #/% comments.
+
+    ``workers`` > 1 (or 0 = auto: GELLY_INGEST_WORKERS env var, else the
+    usable core count) shards the file into byte ranges parsed concurrently
+    by the ingest worker pool (io/ingest.py) — bit-identical output, host
+    parse rate scaling with cores.
     """
+    if workers != 1:
+        from gelly_streaming_tpu.io import ingest
+
+        return ingest.parse_edge_file_parallel(path, workers)
     lib = load_ingest_lib()
     if lib is not None:
         n = lib.count_rows(path.encode())
@@ -65,40 +74,27 @@ def parse_edge_file(path: str):
 
 
 def _parse_edge_file_numpy(path: str):
-    """Pure-python fallback parser (same contract as the native path)."""
-    src, dst, val, tim, sign = [], [], [], [], []
-    any_val = any_time = any_sign = False
+    """Pure-python fallback parser (same contract as the native path).
+
+    ONE line-parsing implementation serves both the serial and the
+    worker-pool fallback paths (io/ingest.py ``_parse_chunk_lines``), so
+    the parallel path's bit-identical-output contract holds by
+    construction, and the file streams chunk-by-chunk (never fully in
+    memory)."""
+    import itertools
+
+    from gelly_streaming_tpu.io import ingest
+
+    parts = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line[0] in "#%":
-                continue
-            parts = line.replace(",", " ").replace("\t", " ").split()
-            if len(parts) < 2:
-                continue
-            src.append(int(parts[0]))
-            dst.append(int(parts[1]))
-            v, t, sg = 0.0, 0, 1
-            if len(parts) > 2:
-                if parts[2] in ("+", "-"):
-                    sg = -1 if parts[2] == "-" else 1
-                    any_sign = True
-                else:
-                    v = float(parts[2])
-                    any_val = True
-            if len(parts) > 3:
-                t = int(float(parts[3]))
-                any_time = True
-            val.append(v)
-            tim.append(t)
-            sign.append(sg)
-    return (
-        np.array(src, np.int64),
-        np.array(dst, np.int64),
-        np.array(val, np.float64) if (any_val and not any_sign) else None,
-        np.array(tim, np.int64) if any_time else None,
-        np.array(sign, np.int32) if any_sign else None,
-    )
+        while True:
+            chunk = list(itertools.islice(f, ingest.FALLBACK_CHUNK_LINES))
+            if not chunk:
+                break
+            parts.append(ingest._parse_chunk_lines(chunk))
+    if not parts:
+        parts = [ingest._parse_chunk_lines([])]
+    return ingest._merge_parsed(parts)
 
 
 def _batched(
@@ -129,8 +125,11 @@ def file_stream(
 
     With no interner given, ids are checked-identity (dense ints) unless any id
     falls outside [0, capacity), in which case a VertexInterner is built.
+
+    Parsing rides the parallel ingest pool (``cfg.ingest_workers``; 0 = auto
+    via GELLY_INGEST_WORKERS / core count — see io/ingest.py).
     """
-    src, dst, val, tim, sign = parse_edge_file(path)
+    src, dst, val, tim, sign = parse_edge_file(path, workers=cfg.ingest_workers)
     if interner is None:
         if len(src) and (
             min(src.min(), dst.min()) < 0

@@ -213,6 +213,42 @@ def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
     return np.concatenate([low_bytes(src), low_bytes(dst)])
 
 
+def pack_edges_into(src: np.ndarray, dst: np.ndarray, width, out: np.ndarray) -> None:
+    """Pack an edge batch directly into ``out`` (a ``uint8[wire_nbytes]``
+    slice, e.g. one row of a superbatch transfer arena).
+
+    The native packers write through the destination pointer with the GIL
+    released — the zero-re-copy path the parallel ingest pool
+    (io/ingest.py) rides; without the native library the packed bytes are
+    copied in from the allocating packer (one extra memcpy, same bytes).
+    """
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    n = src.shape[0]
+    if dst.shape[0] != n:
+        raise ValueError("src/dst length mismatch")
+    expect = wire_nbytes(n, width)
+    if out.dtype != np.uint8 or out.nbytes != expect or not out.flags.c_contiguous:
+        raise ValueError(
+            f"out must be a contiguous uint8 buffer of {expect} bytes"
+        )
+    lib = load_ingest_lib()
+    if lib is not None:
+        out_p = out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        src_p = src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        dst_p = dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if isinstance(width, tuple) and hasattr(lib, "pack_edges_ef40"):
+            if lib.pack_edges_ef40(src_p, dst_p, n, width[1], out_p, expect) == expect:
+                return
+        elif width == PAIR40 and hasattr(lib, "pack_edges40"):
+            if lib.pack_edges40(src_p, dst_p, n, out_p) == expect:
+                return
+        elif width in (2, 3, 4) and hasattr(lib, "pack_edges"):
+            if lib.pack_edges(src_p, dst_p, n, width, out_p) == expect:
+                return
+    out[:] = pack_edges(src, dst, width)
+
+
 def unpack_edges(wire, n: int, width, xp=None):
     """Wire uint8 buffer -> (src, dst) int32[n].
 

@@ -38,6 +38,12 @@ class BipartitenessCheck(SummaryBulkAggregation):
     # in any edge order -> eligible for the EF40 multiset wire encoding
     order_free = True
 
+    @property
+    def cache_token(self):
+        # kernels are pure functions of (class, cfg): share executables
+        # across re-created descriptors
+        return type(self)
+
     def initial_state(self, cfg: StreamConfig) -> BPState:
         return BPState(
             parent2=uf.init_parity_parent(cfg.vertex_capacity),

@@ -46,6 +46,13 @@ class _CCMixin:
     # CC may ride the sorted EF40 multiset wire encoding
     order_free = True
 
+    @property
+    def cache_token(self):
+        # update/combine/initial_state are pure functions of (class, cfg):
+        # re-created descriptors (one per stream/window/bench chunk) share
+        # compiled executables instead of retracing
+        return type(self)
+
     def initial_state(self, cfg: StreamConfig) -> CCState:
         return CCState(
             parent=uf.init_parent(cfg.vertex_capacity),

@@ -218,7 +218,8 @@ class GraphSAGEWindows:
         return kernel, blocks
 
     def _sharded_layer1_windows(self, snapshot: SnapshotStream):
-        """Layer 1 on the sharded plane, one (keys, emb) pair per window."""
+        """Layer 1 on the sharded plane, one (window_id, keys, emb) triple
+        per window (the id lets stacked-layer zipping verify pairing)."""
         kernel, blocks = self._sharded_state(snapshot._stream.cfg.num_shards)
 
         cur_wid = None
@@ -227,13 +228,13 @@ class GraphSAGEWindows:
             kernel, False, extra=blocks
         ):
             if cur_wid is not None and wid != cur_wid and ks:
-                yield np.concatenate(ks), np.concatenate(es)
+                yield cur_wid, np.concatenate(ks), np.concatenate(es)
                 ks, es = [], []
             cur_wid = wid
             ks.append(keys_h)
             es.append(np.asarray(out).astype(np.float32))
         if ks:
-            yield np.concatenate(ks), np.concatenate(es)
+            yield cur_wid, np.concatenate(ks), np.concatenate(es)
 
     def _run_sharded(self, snapshot: SnapshotStream):
         """Ring-sharded window pass: feature blocks [S, C/S, F] stay on their
@@ -243,7 +244,8 @@ class GraphSAGEWindows:
         over a second, bucket-building pass of the same re-runnable stream,
         zipped window-by-window with layer 1's output."""
         if len(self.layers) == 1:
-            yield from self._sharded_layer1_windows(snapshot)
+            for _wid, keys, emb in self._sharded_layer1_windows(snapshot):
+                yield keys, emb
             return
         import copy
         import itertools
@@ -269,10 +271,31 @@ class GraphSAGEWindows:
         hood_groups = itertools.groupby(
             snap2._neighborhood_panes(), key=lambda h: h.pane.window_id
         )
-        for first, (_, hoods) in zip(
-            self._sharded_layer1_windows(snapshot), hood_groups
+        # STRICT zip: the two passes re-run the same source, so their window
+        # sequences must match 1:1.  A one-shot or nondeterministic user
+        # source factory would otherwise exhaust one side early (plain zip
+        # silently truncates) or cut different windows (silently pairing
+        # layer-1 output with a FOREIGN window's buckets) — raise instead.
+        _END = object()
+        for l1, grp in itertools.zip_longest(
+            self._sharded_layer1_windows(snapshot), hood_groups, fillvalue=_END
         ):
-            yield self._stack_layers(list(hoods), first=first)
+            if l1 is _END or grp is _END:
+                raise RuntimeError(
+                    "stacked sharded GraphSAGE: the two window passes "
+                    "disagree on window count — the stream source must be "
+                    "re-runnable and deterministic (pass "
+                    f"{'1' if l1 is _END else '2'} exhausted early)"
+                )
+            wid1, keys, emb = l1
+            wid2, hoods = grp
+            if wid1 != wid2:
+                raise RuntimeError(
+                    "stacked sharded GraphSAGE: window ids diverged between "
+                    f"the two passes ({wid1} vs {wid2}) — the stream source "
+                    "must be re-runnable and deterministic"
+                )
+            yield self._stack_layers(list(hoods), first=(keys, emb))
 
     def output(self, snapshot: SnapshotStream) -> OutputStream:
         """(vertex, embedding-norm) records — a compact observable stream."""

@@ -99,19 +99,58 @@ int64_t count_rows(const char* path) {
   return rows;
 }
 
-int64_t fill_edges(const char* path, int64_t* src, int64_t* dst, double* val,
-                   int64_t* tim, int32_t* sign, int64_t cap,
-                   int32_t* ncols_out) {
+// Byte-range worker plumbing for the PARALLEL ingest pool: a worker owns
+// every line whose FIRST byte offset falls in [begin, end_off).  Seeking to
+// begin > 0 lands mid-line in general, so the worker reads the byte at
+// begin - 1: unless that byte is a newline, the line spanning ``begin``
+// started in the previous worker's range and is skipped.  Lines that START
+// before end_off are parsed to completion even when they extend past it, so
+// adjacent ranges partition the file's lines exactly (no loss, no overlap).
+// Returns the file position of the first owned line, or -1 on I/O error.
+namespace {
+int64_t seek_to_owned_line(FILE* f, int64_t begin, char* line) {
+  if (begin <= 0) return 0;
+  if (fseek(f, begin - 1, SEEK_SET) != 0) return -1;
+  int c = fgetc(f);
+  if (c == EOF) return begin;  // range starts at/past EOF: nothing owned
+  if (c == '\n') return begin;
+  // skip the remainder of the previous range's line (loop: the line may be
+  // longer than one buffer fill)
+  while (fgets(line, 1 << 16, f)) {
+    size_t len = strlen(line);
+    if (len > 0 && line[len - 1] == '\n') break;
+  }
+  return ftell(f);
+}
+}  // namespace
+
+int64_t fill_edges_range(const char* path, int64_t begin, int64_t end_off,
+                         int64_t* src, int64_t* dst, double* val, int64_t* tim,
+                         int32_t* sign, int64_t cap, int32_t* ncols_out) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
   // Whole-line buffered reader (lines are short; fgets is fine and simple).
   char* line = static_cast<char*>(malloc(1 << 16));
+  int64_t pos = seek_to_owned_line(f, begin, line);
+  if (pos < 0) {
+    free(line);
+    fclose(f);
+    return -1;
+  }
   int64_t row = 0;
   int32_t ncols = 2;
   bool sign_col = false;
-  while (fgets(line, 1 << 16, f)) {
+  // at_line_start: a fragment of a line longer than one buffer is still the
+  // OWNER's line (it started before end_off), so the range check applies
+  // only at true line starts — otherwise the owner would stop mid-line and
+  // the next range's skip would drop the middle fragments
+  bool at_line_start = true;
+  while ((!at_line_start || pos < end_off) && fgets(line, 1 << 16, f)) {
+    size_t raw_len = strlen(line);
+    pos += static_cast<int64_t>(raw_len);
+    at_line_start = raw_len > 0 && line[raw_len - 1] == '\n';
     const char* p = line;
-    const char* end = line + strlen(line);
+    const char* end = line + raw_len;
     while (end > p && (end[-1] == '\n' || end[-1] == '\r')) --end;
     skip_seps(&p, end);
     if (p >= end || *p == '#' || *p == '%') continue;
@@ -157,6 +196,43 @@ int64_t fill_edges(const char* path, int64_t* src, int64_t* dst, double* val,
   return row;
 }
 
+int64_t fill_edges(const char* path, int64_t* src, int64_t* dst, double* val,
+                   int64_t* tim, int32_t* sign, int64_t cap,
+                   int32_t* ncols_out) {
+  return fill_edges_range(path, 0, INT64_MAX, src, dst, val, tim, sign, cap,
+                          ncols_out);
+}
+
+// Data-line count within a byte range — the allocation pass of the parallel
+// parser (same ownership rule as fill_edges_range).
+int64_t count_rows_range(const char* path, int64_t begin, int64_t end_off) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char* line = static_cast<char*>(malloc(1 << 16));
+  int64_t pos = seek_to_owned_line(f, begin, line);
+  if (pos < 0) {
+    free(line);
+    fclose(f);
+    return -1;
+  }
+  int64_t rows = 0;
+  bool at_line_start = true;  // same fragment-ownership rule as fill_edges_range
+  while ((!at_line_start || pos < end_off) && fgets(line, 1 << 16, f)) {
+    size_t len = strlen(line);
+    pos += static_cast<int64_t>(len);
+    at_line_start = len > 0 && line[len - 1] == '\n';
+    const char* p = line;
+    const char* end = line + len;
+    while (end > p && (end[-1] == '\n' || end[-1] == '\r')) --end;
+    skip_seps(&p, end);
+    if (p >= end || *p == '#' || *p == '%') continue;
+    ++rows;
+  }
+  free(line);
+  fclose(f);
+  return rows;
+}
+
 // Pack a (src, dst) edge batch into the compact device wire format: the src
 // block then the dst block, each id truncated to `width` little-endian bytes
 // (width in {2, 3, 4}; callers pick the narrowest width that covers the
@@ -166,13 +242,27 @@ int64_t fill_edges(const char* path, int64_t* src, int64_t* dst, double* val,
 int64_t pack_edges(const int32_t* src, const int32_t* dst, int64_t n,
                    int32_t width, uint8_t* out) {
   if (width < 1 || width > 4) return -1;
+  const uint16_t kEndianProbe = 1;
+  const bool kLittleEndian =
+      *reinterpret_cast<const uint8_t*>(&kEndianProbe) == 1;
   const int32_t* blocks[2] = {src, dst};
   uint8_t* q = out;
   for (const int32_t* block : blocks) {
     switch (width) {
       case 4:
-        memcpy(q, block, n * 4);
-        q += n * 4;
+        if (kLittleEndian) {  // int32 memory bytes == little-endian wire
+          memcpy(q, block, n * 4);
+          q += n * 4;
+        } else {
+          for (int64_t i = 0; i < n; ++i) {
+            uint32_t v = static_cast<uint32_t>(block[i]);
+            q[0] = v & 0xFF;
+            q[1] = (v >> 8) & 0xFF;
+            q[2] = (v >> 16) & 0xFF;
+            q[3] = (v >> 24) & 0xFF;
+            q += 4;
+          }
+        }
         break;
       case 3:
         for (int64_t i = 0; i < n; ++i) {
@@ -357,11 +447,29 @@ int64_t pack_edges_ef40(const int32_t* src, const int32_t* dst, int64_t n,
   int64_t npairs = (n + 1) / 2;
   // bulk pairs: one unaligned 8-byte store each (3 bytes of overrun are
   // rewritten by the next pair); the final pair writes exactly 5 bytes so
-  // the buffer end is never crossed
-  for (int64_t i = 0; i + 1 < npairs; ++i) {
-    uint64_t w = (uint64_t)lows[2 * i] | ((uint64_t)lows[2 * i + 1] << 20);
-    memcpy(q, &w, 8);
-    q += 5;
+  // the buffer end is never crossed.  The memcpy trick assumes the uint64's
+  // in-memory bytes ARE the little-endian wire bytes — true only on a
+  // little-endian host; big-endian builds take the explicit byte stores so
+  // native output stays bit-identical to the numpy fallback.
+  const uint16_t kEndianProbe = 1;
+  const bool kLittleEndian =
+      *reinterpret_cast<const uint8_t*>(&kEndianProbe) == 1;
+  if (kLittleEndian) {
+    for (int64_t i = 0; i + 1 < npairs; ++i) {
+      uint64_t w = (uint64_t)lows[2 * i] | ((uint64_t)lows[2 * i + 1] << 20);
+      memcpy(q, &w, 8);
+      q += 5;
+    }
+  } else {
+    for (int64_t i = 0; i + 1 < npairs; ++i) {
+      uint64_t w = (uint64_t)lows[2 * i] | ((uint64_t)lows[2 * i + 1] << 20);
+      q[0] = w & 0xFF;
+      q[1] = (w >> 8) & 0xFF;
+      q[2] = (w >> 16) & 0xFF;
+      q[3] = (w >> 24) & 0xFF;
+      q[4] = (w >> 32) & 0xFF;
+      q += 5;
+    }
   }
   if (npairs > 0) {
     uint64_t w = (uint64_t)lows[2 * (npairs - 1)] |

@@ -158,7 +158,12 @@ class JaxWatermarkBoard:
         import jax
         from jax.experimental import multihost_utils
 
-        with jax.enable_x64(True):
+        # export location moved across jax versions (top-level >= 0.5,
+        # jax.experimental before)
+        enable_x64 = getattr(jax, "enable_x64", None)
+        if enable_x64 is None:
+            from jax.experimental import enable_x64
+        with enable_x64(True):
             out = multihost_utils.process_allgather(
                 np.asarray(local_watermark, np.int64)
             )

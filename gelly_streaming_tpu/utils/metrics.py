@@ -74,6 +74,25 @@ class WindowLatencyRecorder:
         return self.percentile(50)
 
 
+def compile_cache_stats() -> dict:
+    """Process-wide executable-cache counters (core/compile_cache.py):
+    entry hits/misses, XLA compiles + compile wall time, steady-state
+    dispatch hits, and the retrace count (``recompiles`` — compile events
+    beyond the first for the same kernel label + shape, which a healthy
+    streaming run keeps at zero)."""
+    from gelly_streaming_tpu.core import compile_cache
+
+    return compile_cache.stats()
+
+
+def reset_compile_cache_stats() -> None:
+    """Zero the executable-cache counters (executables stay cached) —
+    call before a measurement window, read ``compile_cache_stats`` after."""
+    from gelly_streaming_tpu.core import compile_cache
+
+    compile_cache.reset_stats()
+
+
 @contextlib.contextmanager
 def profiled(trace_dir: Optional[str] = None):
     """jax.profiler trace context; no-op when trace_dir is None."""

@@ -105,6 +105,29 @@ def load_ingest_lib():
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.fill_edges.restype = ctypes.c_int64
+        # byte-range workers of the parallel ingest pool (io/ingest.py);
+        # bound only when the .so carries them (prebuilt libs may predate)
+        if hasattr(lib, "fill_edges_range"):
+            lib.fill_edges_range.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.fill_edges_range.restype = ctypes.c_int64
+        if hasattr(lib, "count_rows_range"):
+            lib.count_rows_range.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+            ]
+            lib.count_rows_range.restype = ctypes.c_int64
         lib.cc_baseline.argtypes = [
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32),

@@ -26,10 +26,71 @@ if "xla_force_host_platform_device_count" not in _flags:
 # the ppermute-heavy mesh tests under host load).  Starvation must be a
 # slow test, never suite death.  (Per-flag guards: never shadow a
 # user-set value with an appended duplicate.)
-if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in _flags:
-    _flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
-    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+#
+# NOT every XLA build knows these flags — and XLA FATALLY aborts the whole
+# process on an unknown XLA_FLAGS entry (parse_flags_from_env.cc), killing
+# the suite before pytest prints a byte.  Probe support in a throwaway
+# subprocess first and only append the flags a real jax init accepts.
+
+
+def _xla_accepts(flag: str) -> bool:
+    """Probe once per jax version, caching the verdict on disk: the probe
+    costs a full cold jax init (~seconds), too much to pay per pytest run."""
+    import subprocess
+    import tempfile
+
+    try:
+        from importlib.metadata import version
+
+        ver = version("jax")
+    except Exception:
+        ver = "unknown"
+    marker = os.path.join(
+        tempfile.gettempdir(), f"gelly_xla_flag_probe_{ver}.txt"
+    )
+    try:
+        with open(marker) as f:
+            return f.read().strip() == "ok"
+    except OSError:
+        pass
+    env = dict(os.environ, XLA_FLAGS=flag, JAX_PLATFORMS="cpu")
+    ok = False
+    flag_rejected = False
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env,
+            capture_output=True,
+            timeout=120,
+        )
+        ok = probe.returncode == 0
+        # XLA's unknown-flag abort is the ONE durable negative; anything
+        # else (timeout, OOM, load spike) is transient and must be
+        # re-probed next run, not cached as a permanent "bad"
+        flag_rejected = b"Unknown flags in XLA_FLAGS" in (probe.stderr or b"")
+    except Exception:
+        pass
+    if ok or flag_rejected:
+        try:
+            with open(marker, "w") as f:
+                f.write("ok" if ok else "bad")
+        except OSError:
+            pass
+    return ok
+
+
+_timeout_flags = [
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=900",
+]
+_missing = [
+    f
+    for f in _timeout_flags
+    # per-flag guard: never shadow a user-set value with a duplicate
+    if f[2:].split("=")[0] not in _flags
+]
+if _missing and _xla_accepts(" ".join(_timeout_flags)):
+    _flags += " " + " ".join(_missing)
 os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -22,7 +22,13 @@ _WORKER = textwrap.dedent(
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except AttributeError:  # older jax: pre-init XLA flag instead
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
 
     coord, pid, phase, ckpt = (
         sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
@@ -120,6 +126,13 @@ def _run_pair(tmp_path, phase, ckpt):
         stdout, stderr = out_f.read(), err_f.read()
         out_f.close()
         err_f.close()
+        if "Multiprocess computations aren't implemented" in stderr:
+            import pytest
+
+            pytest.skip(
+                "this jax build's CPU backend has no multi-process "
+                "collectives (jax.distributed over CPU unsupported)"
+            )
         assert p.returncode == 0, stderr[-3000:]
         line = [l for l in stdout.splitlines() if l.startswith("RESULT ")][-1]
         outs.append(json.loads(line[len("RESULT "):]))

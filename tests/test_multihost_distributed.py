@@ -108,6 +108,13 @@ def test_two_process_jax_distributed_lockstep(tmp_path):
         stdout, stderr = out_f.read(), err_f.read()
         out_f.close()
         err_f.close()
+        if "Multiprocess computations aren't implemented" in stderr:
+            import pytest
+
+            pytest.skip(
+                "this jax build's CPU backend has no multi-process "
+                "collectives (jax.distributed over CPU unsupported)"
+            )
         assert p.returncode == 0, stderr[-2000:]
         line = [l for l in stdout.splitlines() if l.startswith("RESULT ")][-1]
         outs.append(json.loads(line[len("RESULT ") :]))

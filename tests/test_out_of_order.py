@@ -206,3 +206,84 @@ def test_aggregate_cc_with_out_of_order_stream():
     # and the final summary is the full merge: {1,2,3,4} and {5,6}
     members = sorted(tuple(sorted(v)) for v in got.values())
     assert members == [(1, 2, 3, 4), (5, 6)]
+
+
+def test_window_fires_at_max_timestamp_boundary():
+    """Flink's trigger boundary: a window fires once the watermark reaches
+    its maxTimestamp (end - 1), not end.  With bound=0 a record at exactly
+    t=999 drives the watermark to window 0's maxTimestamp, so window 0
+    closes immediately and a later sub-1000 record is LATE."""
+    edges = [
+        (1, 2, 10, 100),
+        (1, 5, 7, 999),  # watermark -> 999 == maxTimestamp(window 0)
+        (3, 4, 5, 500),  # window 0 already fired -> late
+        (2, 3, 9, 2600),
+    ]
+    lates = []
+    got = _reduce_records(
+        _stream(edges, bound=0).on_late(
+            lambda s, d, v, t: lates.extend(zip(s.tolist(), t.tolist()))
+        )
+    )
+    assert got == [(1, 17), (2, 9)]
+    assert lates == [(3, 500)]
+
+
+def test_window_not_late_one_tick_before_boundary():
+    """One tick earlier (watermark = maxTimestamp - 1) the window is still
+    open and the straggler joins it."""
+    edges = [
+        (1, 2, 10, 100),
+        (1, 5, 7, 998),  # watermark 998 < 999: window 0 still open
+        (3, 4, 5, 500),  # joins window 0
+        (2, 3, 9, 2600),
+    ]
+    got = _reduce_records(_stream(edges, bound=0))
+    assert got == [(1, 17), (2, 9), (3, 5)]
+
+
+def test_union_preserves_late_sink_from_inputs():
+    """ADVICE round-5 finding: union() used to mint a fresh late holder,
+    silently dropping a sink attached to either input chain."""
+    # batch_size=1: round-robin arrival order is 100, 1500, 2600, 800 —
+    # ascending except the final record, which is late at bound=0
+    left_edges = [(1, 2, 10, 100), (2, 3, 9, 2600)]
+    right_edges = [(3, 4, 5, 1500), (1, 5, 7, 800)]  # (1,5) late at bound=0
+    lates = []
+    left = _stream(left_edges, bound=0, batch_size=1)
+    right = _stream(right_edges, bound=0, batch_size=1)
+    left.on_late(lambda s, d, v, t: lates.extend(zip(s.tolist(), t.tolist())))
+    unioned = left.union(right)
+    _reduce_records(unioned)
+    assert lates == [(1, 800)]
+
+
+def test_union_late_sink_fans_out_to_both_chains():
+    """A sink attached to the UNIONED stream routes late records whichever
+    input chain they came from — and is seen when an input chain is
+    consumed on its own too."""
+    left_edges = [(1, 2, 10, 100), (2, 3, 9, 2600)]
+    right_edges = [(3, 4, 5, 1500), (1, 5, 7, 800)]
+    lates = []
+    left = _stream(left_edges, bound=0, batch_size=1)
+    right = _stream(right_edges, bound=0, batch_size=1)
+    unioned = left.union(right)
+    unioned.on_late(
+        lambda s, d, v, t: lates.extend(zip(s.tolist(), t.tolist()))
+    )
+    _reduce_records(unioned)
+    assert lates == [(1, 800)]
+    # the fan-out also landed the sink on the input chain itself
+    lates.clear()
+    _reduce_records(right)
+    assert lates == [(1, 800)]
+
+
+def test_union_sink_attached_to_input_after_union_is_seen():
+    left = _stream([(1, 2, 10, 100), (2, 3, 9, 2600)], bound=0, batch_size=1)
+    right = _stream([(3, 4, 5, 1500), (1, 5, 7, 800)], bound=0, batch_size=1)
+    unioned = left.union(right)
+    lates = []
+    right.on_late(lambda s, d, v, t: lates.append(len(s)))  # after union()
+    _reduce_records(unioned)
+    assert lates == [1]

@@ -1,0 +1,160 @@
+"""Parallel host ingest (io/ingest.py): the worker-pool parse and pack
+stages must be BIT-EXACT against their single-threaded counterparts — the
+whole point of range/arena sharding is speed with zero semantic surface.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.io import ingest, sources, wire
+
+
+def _write(tmp_path, name, lines, newline="\n", trailing=True):
+    path = tmp_path / name
+    body = newline.join(lines) + (newline if trailing else "")
+    path.write_text(body)
+    return str(path)
+
+
+def _assert_same_parse(path):
+    serial = sources.parse_edge_file(path, workers=1)
+    parallel = ingest.parse_edge_file_parallel(path, workers=4)
+    for a, b in zip(serial, parallel):
+        if a is None:
+            assert b is None
+        else:
+            assert np.array_equal(a, b)
+
+
+def test_parallel_parse_matches_serial_all_column_shapes(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 3000
+    ids = rng.integers(0, 500, (n, 2))
+    cases = {
+        "plain.txt": [f"{s} {d}" for s, d in ids],
+        "valued.txt": [f"{s},{d},{(s + d) / 7:.5f}" for s, d in ids],
+        "timed.txt": [f"{s}\t{d}\t{s % 3}.5\t{i}" for i, (s, d) in enumerate(ids)],
+        "signed.txt": [
+            f"{s} {d} {'+' if i % 3 else '-'}" for i, (s, d) in enumerate(ids)
+        ],
+    }
+    for name, lines in cases.items():
+        # comments + blank lines interleaved, as real edge lists have
+        salted = ["# header", ""]
+        for i, ln in enumerate(lines):
+            salted.append(ln)
+            if i % 500 == 0:
+                salted.append("% interleaved comment")
+        _assert_same_parse(_write(tmp_path, name, salted))
+
+
+def test_parallel_parse_edge_cases(tmp_path):
+    # no trailing newline: the final line belongs to the last range
+    _assert_same_parse(
+        _write(tmp_path, "notrail.txt", ["1 2", "3 4", "5 6"], trailing=False)
+    )
+    # tiny file: collapses to one range (serial path), still correct
+    _assert_same_parse(_write(tmp_path, "tiny.txt", ["7 8"]))
+    # comments only: zero edges
+    src, dst, val, tim, sign = ingest.parse_edge_file_parallel(
+        _write(tmp_path, "comments.txt", ["# a", "% b"]), workers=4
+    )
+    assert len(src) == 0 and val is None and tim is None and sign is None
+
+
+def test_parallel_parse_range_boundaries_partition_lines(tmp_path):
+    """Force many ranges over a small file: every line parsed exactly once
+    whatever the byte boundaries land on."""
+    lines = [f"{i} {i + 1}" for i in range(997)]  # varying line lengths
+    path = _write(tmp_path, "bounds.txt", lines)
+    serial = sources.parse_edge_file(path, workers=1)
+    old = ingest.MIN_RANGE_BYTES
+    ingest.MIN_RANGE_BYTES = 64  # force ~dozens of ranges
+    try:
+        parallel = ingest.parse_edge_file_parallel(path, workers=16)
+    finally:
+        ingest.MIN_RANGE_BYTES = old
+    assert np.array_equal(serial[0], parallel[0])
+    assert np.array_equal(serial[1], parallel[1])
+
+
+@pytest.mark.parametrize("width", [2, 3, 4, wire.PAIR40])
+def test_pack_rows_into_bit_exact(width):
+    rng = np.random.default_rng(1)
+    batch, groups = 512, 5
+    hi = 1 << 15 if width == 2 else 1 << 19  # ids must fit the encoding
+    src = rng.integers(0, hi, batch * groups).astype(np.int32)
+    dst = rng.integers(0, hi, batch * groups).astype(np.int32)
+    nbytes = wire.wire_nbytes(batch, width)
+    arena = np.empty((groups, nbytes), np.uint8)
+    ingest.pack_rows_into(src, dst, 0, groups, batch, width, arena, workers=4)
+    for j in range(groups):
+        ref = wire.pack_edges(
+            src[j * batch : (j + 1) * batch], dst[j * batch : (j + 1) * batch], width
+        )
+        assert np.array_equal(arena[j], ref)
+
+
+def test_parallel_pack_stream_matches_serial_including_ef40():
+    rng = np.random.default_rng(2)
+    src = rng.integers(0, 4096, 10_000).astype(np.int32)
+    dst = rng.integers(0, 4096, 10_000).astype(np.int32)
+    for width in (3, (wire.EF40, 4096)):
+        ref_bufs, ref_tail = wire.pack_stream(src, dst, 1024, width)
+        par_bufs, par_tail = ingest.parallel_pack_stream(
+            src, dst, 1024, width, workers=4
+        )
+        assert len(ref_bufs) == len(par_bufs)
+        for a, b in zip(ref_bufs, par_bufs):
+            assert np.array_equal(a, b)
+        assert np.array_equal(ref_tail[0], par_tail[0])
+        assert np.array_equal(ref_tail[1], par_tail[1])
+
+
+def test_pack_edges_into_rejects_bad_buffer():
+    src = np.arange(8, dtype=np.int32)
+    with pytest.raises(ValueError):
+        wire.pack_edges_into(src, src, 2, np.empty(3, np.uint8))
+
+
+def test_resolve_workers_env(monkeypatch):
+    assert ingest.resolve_workers(3) == 3
+    monkeypatch.setenv("GELLY_INGEST_WORKERS", "5")
+    assert ingest.resolve_workers(0) == 5
+    monkeypatch.delenv("GELLY_INGEST_WORKERS")
+    assert ingest.resolve_workers(0) >= 1
+
+
+def test_file_stream_parses_in_parallel_by_default(tmp_path):
+    """file_stream rides cfg.ingest_workers (0 = auto) and produces the same
+    stream as a serial parse."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.io.sources import file_stream
+
+    lines = [f"{i % 50} {(i * 7) % 50}" for i in range(2000)]
+    path = _write(tmp_path, "stream.txt", lines)
+    cfg = StreamConfig(vertex_capacity=64, batch_size=256)
+    stream, _ = file_stream(path, cfg)
+    got = stream.collect_edges()
+    want = [(i % 50, (i * 7) % 50) for i in range(2000)]
+    assert got == want
+
+
+def test_parallel_parse_long_lines_across_range_boundaries(tmp_path):
+    """Lines longer than the native reader's 64KB buffer must parse
+    identically in serial and parallel (fragment ownership: every fragment
+    of a line belongs to the range its line STARTED in)."""
+    long_pad = "# " + "x" * (70 << 10)  # one >64KB comment line
+    lines = ["1 2", long_pad, "3 4", "5 6", long_pad, "7 8"]
+    path = _write(tmp_path, "long.txt", lines)
+    serial = sources.parse_edge_file(path, workers=1)
+    old = ingest.MIN_RANGE_BYTES
+    ingest.MIN_RANGE_BYTES = 1 << 12  # boundaries land inside the long lines
+    try:
+        parallel = ingest.parse_edge_file_parallel(path, workers=8)
+    finally:
+        ingest.MIN_RANGE_BYTES = old
+    assert np.array_equal(serial[0], parallel[0])
+    assert np.array_equal(serial[1], parallel[1])

@@ -102,7 +102,11 @@ def test_wire_checkpoint_done_reemits_without_refolding(tmp_path, monkeypatch):
     def boom(*a, **k):
         raise AssertionError("resume of a done stream must not refold")
 
+    # patch BOTH prefetcher entry points: the array-backed fast path builds
+    # the generic Prefetcher (superbatch-aware grouping), older paths the
+    # WirePrefetcher — the sentinel must fire whichever a regression uses
     monkeypatch.setattr(wire, "WirePrefetcher", boom)
+    monkeypatch.setattr(wire, "Prefetcher", boom)
     again = (
         EdgeStream.from_arrays(src, dst, cfg)
         .aggregate(ConnectedComponents(), checkpoint_path=path)

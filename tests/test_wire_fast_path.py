@@ -112,15 +112,19 @@ def test_wire_partial_tail_batch():
 
 def test_wire_path_repeat_run_reuses_cache():
     # OutputStream is re-runnable; the second run must produce the same result
-    # (fresh state) and hit the compiled-step cache
+    # (fresh state) and reuse the process-global executable cache
+    # (core/compile_cache.py) instead of retracing
+    from gelly_streaming_tpu.core import compile_cache
+
     src, dst = _random_edges(n=512, c=64)
     cfg = StreamConfig(vertex_capacity=64, batch_size=128)
     agg = ConnectedComponents()
     out = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg)
     first = out.collect()
-    assert len(agg._wire_step_cache) == 1
+    compile_cache.reset_stats()
     second = out.collect()
-    assert len(agg._wire_step_cache) == 1
+    stats = compile_cache.stats()
+    assert stats["compiles"] == 0, stats
     assert first[0][0].components() == second[0][0].components()
 
 
