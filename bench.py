@@ -77,7 +77,9 @@ runner.
 If the device backend cannot initialize (tunnel down), the watchdog emits an
 explainable JSON line that still carries the pinned CPU baseline measured
 before device init, plus the last builder-attested green run
-(``last_green_builder``) as explicit partials.
+(``last_green_builder``) as explicit partials — marked
+``"device_unavailable": true`` and exiting rc 0, so a tunnel outage records
+the host-side numbers instead of reading as a bench failure.
 
 Scale knobs via env: GELLY_BENCH_EDGES (default 104857600 = 50 x 2^21 —
 the >=100M north-star volume), GELLY_BENCH_VERTICES (default 2^20),
@@ -97,6 +99,16 @@ Host-ingest keys (ISSUE 1): ``ingest_pack_eps_by_workers`` /
 ``ingest_*_speedup_at_4plus`` the multi-worker multiple over one thread;
 ``cache_recompiles`` counts XLA recompiles across 100 same-shape windows
 after warmup (target 0 — the executable cache, core/compile_cache.py).
+
+Async-window keys (ISSUE 2): ``sync_window_eps`` / ``async_window_eps`` /
+``async_window_speedup`` compare the windowed plane's lockstep loop against
+the asynchronous pipeline (core/async_exec.py; GELLY_BENCH_ASYNC=0 skips,
+GELLY_ASYNC_WINDOWS sets the depth, default 4) over 100 same-shape windows
+with a materializing consumer; ``async_emissions_equal`` attests the record
+sequences matched bit-for-bit and ``async_cache_recompiles`` that the async
+plane stayed at zero recompiles.  The ``pipeline_*`` keys are the
+occupancy counters (utils/metrics.pipeline_stats): in-flight window
+high-water mark, per-stage stall seconds, prefetch depth, window counts.
 """
 
 import ctypes
@@ -267,6 +279,95 @@ def _triangle_latency(seed: int = 0, windows: int = 15, k: int = 4096):
     }
 
 
+def _async_window_bench(
+    windows: int = 100, win_edges: int = 1 << 13, capacity: int = 1 << 16
+):
+    """Windowed-plane throughput, sync vs async pipeline (ISSUE 2).
+
+    Many small SAME-SHAPE event-time windows of CC through the windowed
+    runtime (not the wire fast path), with a materializing consumer — every
+    window's emission is fetched to host, the realistic sink contract
+    (collect/CSV/checkpoint all materialize) and the regime the synchronous
+    loop serializes: host windowing -> fold -> blocking fetch, one window
+    at a time.  The async pipeline (cfg.async_windows) overlaps the three;
+    emissions are compared for exact equality and recompiles are counted
+    across the async windows (the executable-cache guard extended to the
+    async plane: same shapes -> zero recompiles).
+    """
+    import dataclasses
+
+    import jax
+
+    from gelly_streaming_tpu.core import compile_cache
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.core.types import EdgeBatch
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+    from gelly_streaming_tpu.utils import metrics
+
+    n = windows * win_edges
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, capacity, n).astype(np.int64)
+    dst = rng.integers(0, capacity, n).astype(np.int64)
+    t_ms = (np.arange(n) // win_edges) * 100 + 50  # 100ms tumbling panes
+    bs = win_edges // 2  # batches never align with window cuts
+
+    cfg_sync = StreamConfig(vertex_capacity=capacity, batch_size=bs)
+    cfg_async = dataclasses.replace(
+        cfg_sync, async_windows=int(os.environ.get("GELLY_ASYNC_WINDOWS", 4))
+    )
+    # The env var is captured into cfg_async above and must NOT leak into
+    # the sync oracle runs: with cfg_sync left at 0, resolve_depth would
+    # fall through to the var and silently flip the "sync" baseline onto
+    # the async path (a self-comparison reading ~1.0x).  Hold it cleared
+    # for the whole stage — both modes are explicit via their configs.
+    env_depth = os.environ.pop("GELLY_ASYNC_WINDOWS", None)
+
+    def factory():
+        for i in range(0, n, bs):
+            yield EdgeBatch.from_arrays(
+                src[i : i + bs], dst[i : i + bs], time=t_ms[i : i + bs]
+            )
+
+    def run(cfg):
+        out = []
+        stream = EdgeStream.from_batches(factory, cfg)
+        for rec in ConnectedComponents(window_ms=100).run(stream):
+            # materialize the emission (what any real sink does per window)
+            out.append(np.asarray(rec[0].parent))
+        return out
+
+    try:
+        run(cfg_sync)  # compile + warm both paths
+        run(cfg_async)
+        t0 = time.perf_counter()
+        sync_out = run(cfg_sync)
+        sync_eps = n / (time.perf_counter() - t0)
+        metrics.reset_pipeline_stats()
+        compile_cache.reset_stats()
+        t0 = time.perf_counter()
+        async_out = run(cfg_async)
+        async_eps = n / (time.perf_counter() - t0)
+        recompiles = compile_cache.stats()["recompiles"]
+    finally:
+        if env_depth is not None:
+            os.environ["GELLY_ASYNC_WINDOWS"] = env_depth
+    equal = len(sync_out) == len(async_out) and all(
+        np.array_equal(a, b) for a, b in zip(sync_out, async_out)
+    )
+    return {
+        "sync_window_eps": round(sync_eps, 1),
+        "async_window_eps": round(async_eps, 1),
+        "async_window_speedup": round(async_eps / sync_eps, 2),
+        "async_windows_depth": cfg_async.async_windows,
+        "async_emissions_equal": bool(equal),
+        "async_cache_recompiles": recompiles,
+        **metrics.pipeline_stats(),
+    }
+
+
 _PARTIAL = {}  # best results so far, emitted by the deadline watchdog
 
 
@@ -335,7 +436,9 @@ def _watcher_log_summary():
     }
 
 
-def _watchdog(seconds: float, what: str, exit_code: int):
+def _watchdog(
+    seconds: float, what: str, exit_code: int, device_unavailable: bool = False
+):
     """Emit an explainable JSON line and exit if ``what`` wedges.
 
     The session tunnel's client creation — and, observed later in round 3,
@@ -344,7 +447,15 @@ def _watchdog(seconds: float, what: str, exit_code: int):
     artifact.  The emitted line carries whatever metrics were already
     measured (``_PARTIAL``) — including the pinned CPU baseline (measured
     before device init) and the last builder-attested green run.  Returns a
-    cancel()."""
+    cancel().
+
+    ``device_unavailable`` marks the device-init watchdog: a tunnel outage
+    before the backend even exists is an environmental condition, not a
+    bench failure — the artifact carries ``"device_unavailable": true`` and
+    the process exits 0, so the trajectory keeps recording the host-side
+    numbers (CPU baseline, flink proxy, ingest scaling) through outages
+    instead of discarding them behind a nonzero rc.
+    """
     import threading
 
     done = threading.Event()
@@ -363,6 +474,7 @@ def _watchdog(seconds: float, what: str, exit_code: int):
                         "value": value,
                         "unit": "edges/s",
                         "vs_baseline": None,
+                        "device_unavailable": device_unavailable,
                         "last_green_builder": LAST_GREEN_BUILDER,
                         "last_real_chip_run": LAST_REAL_CHIP_RUN,
                         "watcher": _watcher_log_summary(),
@@ -371,7 +483,7 @@ def _watchdog(seconds: float, what: str, exit_code: int):
                 ),
                 flush=True,
             )
-            os._exit(exit_code)
+            os._exit(0 if device_unavailable else exit_code)
 
     threading.Thread(target=watch, daemon=True).start()
     return done.set
@@ -598,6 +710,9 @@ def main():
         float(os.environ.get("GELLY_BENCH_INIT_TIMEOUT", 600)),
         "device backend init",
         3,
+        # partial host-side results + rc 0: a down tunnel must not read as
+        # a bench failure (the artifact says device_unavailable instead)
+        device_unavailable=True,
     )
     import jax
 
@@ -698,6 +813,34 @@ def main():
         )
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"executable cache guard skipped: {e}", file=sys.stderr)
+
+    # ---- windowed-plane async pipeline: sync vs async, same emissions ------
+    # (ISSUE 2 acceptance: many small same-shape windows, >= 1.2x with
+    # async_windows on, bit-identical emission sequence, zero recompiles,
+    # occupancy counters reported next to the compile-cache keys)
+    async_stats = {}
+    try:
+        if os.environ.get("GELLY_BENCH_ASYNC", "1") != "0":
+            async_stats = _async_window_bench(
+                windows=int(os.environ.get("GELLY_BENCH_ASYNC_WINDOWS_N", 100)),
+                win_edges=int(
+                    os.environ.get("GELLY_BENCH_ASYNC_WIN_EDGES", 1 << 13)
+                ),
+            )
+            _PARTIAL.update(async_stats)
+            print(
+                f"async windows: sync "
+                f"{async_stats['sync_window_eps'] / 1e6:.2f}M eps vs async "
+                f"{async_stats['async_window_eps'] / 1e6:.2f}M eps "
+                f"(x{async_stats['async_window_speedup']}, depth "
+                f"{async_stats['async_windows_depth']}), emissions equal: "
+                f"{async_stats['async_emissions_equal']}, recompiles "
+                f"{async_stats['async_cache_recompiles']}, in-flight HWM "
+                f"{async_stats['pipeline_inflight_high_water']}",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"async window bench skipped: {e}", file=sys.stderr)
 
     # ---- device-only fold rate + roofline (needs a fresh link: even
     # dispatch RPCs get ~100ms+ latency once the tunnel throttles, so this
@@ -1107,6 +1250,7 @@ def main():
                 **sage,
                 **ingest_stats,
                 **cache_guard,
+                **async_stats,
             }
         )
     )

@@ -618,6 +618,7 @@ class SummaryAggregation:
         pending_final = True
         try:
             pos = start_batch
+            # hot-loop: wire fast-path fold (no per-batch host syncs)
             for g, dev in device_buffers():
                 if g == 1:
                     carry = fused(carry, dev)
@@ -643,6 +644,7 @@ class SummaryAggregation:
                     # fused call donates it away
                     snapshot(pos, False, carry)
                     since_snap = 0
+            # hot-loop-end
             if tail_pair is not None:
                 rem = len(tail_pair[0])
                 mask = np.zeros((batch,), bool)
@@ -771,6 +773,20 @@ class SummaryAggregation:
 
             return OutputStream(records_sb)
 
+        from gelly_streaming_tpu.core import async_exec
+
+        if async_exec.resolve_depth(cfg) > 0 and n_parts == 1:
+            # asynchronous window pipeline: pane padding on the pack thread
+            # into reusable arenas, transfers on the second thread, folds
+            # dispatched without waiting, emissions drained in window order
+            # (core/async_exec.py) — bit-identical record sequence to the
+            # synchronous fold_pane path below
+            return OutputStream(
+                lambda: self._async_pane_records(
+                    stream, window_ms, checkpoint_path, restore
+                )
+            )
+
         def fold_pane(pane: WindowPane):
             partials = []
             for part in range(n_parts):
@@ -838,6 +854,91 @@ class SummaryAggregation:
             return -1, False  # legacy layout: merge loop sorts it out
         return int(snap["last_window"]), bool(snap["global_done"])
 
+    def _async_pane_records(
+        self,
+        stream,
+        window_ms: int,
+        checkpoint_path: Optional[str],
+        restore: bool,
+    ) -> Iterator[tuple]:
+        """Single-partition windowed plane on the async pipeline.
+
+        Per pane: padding to the pow2 fold bucket happens on the
+        prefetcher's pack thread, writing into reusable transfer arenas
+        (async_exec.ArenaPool — recycled only once the consuming fold is
+        known complete, since a CPU device_put may alias the host buffer);
+        the device_put rides the transfer thread; the fold dispatches here
+        through the SAME cached ``_update_j`` executable the synchronous
+        ``fold_pane`` traces, so the per-window partials — and therefore
+        the merged emission sequence — are bit-identical to the sync path
+        (pinned by tests/test_async_windows.py).  Emission/checkpoint
+        ordering rides the async Merger (`_merge_loop` -> async_merge_loop).
+        """
+        from gelly_streaming_tpu.core import async_exec
+        from gelly_streaming_tpu.io import wire as wire_mod
+
+        cfg = stream.cfg
+        depth = async_exec.resolve_depth(cfg)
+        skip_through, skip_global = self._restored_position(
+            cfg, checkpoint_path, restore
+        )
+        # retention cap sized to the pipeline's own in-flight bound (two
+        # int32 arenas per pane x panes across the prefetch + completion
+        # queues), so steady state recycles instead of reallocating
+        pool = async_exec.ArenaPool(per_shape=2 * depth + 6)
+
+        def prepare(pane: WindowPane):
+            already = (0 <= pane.window_id <= skip_through) or (
+                pane.window_id == -1 and skip_global
+            )
+            n = pane.num_edges
+            if already or n == 0:
+                return (pane, None), None
+            padded = max(1, 1 << (n - 1).bit_length())
+            src = pool.acquire((padded,), np.int32)
+            dst = pool.acquire((padded,), np.int32)
+            mask = pool.acquire((padded,), bool)
+            src[:n] = pane.src
+            dst[:n] = pane.dst
+            mask[:n] = True
+            val = None
+            if pane.val is not None:
+
+                def pad(a):
+                    out = np.zeros((padded,) + a.shape[1:], a.dtype)
+                    out[:n] = a
+                    return out
+
+                val = jax.tree.map(pad, pane.val)
+            return (pane, (src, dst, mask)), (src, dst, val, mask)
+
+        def fold_prepared(item):
+            (pane, arenas), dev = item
+            if arenas is None:
+                return None
+            src_d, dst_d, val_d, mask_d = dev
+            return self._update_j(
+                self.initial_state(cfg), src_d, dst_d, val_d, mask_d
+            )
+
+        def release(item):
+            (pane, arenas), _dev = item
+            if arenas is not None:
+                pool.release(*arenas)
+
+        with wire_mod.Prefetcher(
+            stream_panes(stream, window_ms), prepare, depth=depth + 1
+        ) as pf:
+            yield from self._merge_loop(
+                cfg,
+                ((meta[0], (meta, dev)) for meta, dev in pf),
+                fold_prepared,
+                checkpoint_path,
+                restore,
+                unwrap=True,
+                release=release,
+            )
+
     def _superpane_fold_fn(self, cfg: StreamConfig, has_val: bool):
         """Compiled K-window fold: ONE dispatch produces every coalesced
         window's partial summary via a vmap over per-window edge rows.
@@ -884,6 +985,7 @@ class SummaryAggregation:
         path never folds them either, and recovery must not pay a full
         re-fold of the pre-crash stream).
         """
+        from gelly_streaming_tpu.core import async_exec
         from gelly_streaming_tpu.core.windows import group_panes
 
         cfg = stream.cfg
@@ -895,33 +997,30 @@ class SummaryAggregation:
                 or (p.window_id == -1 and skip_global)
             )
         )
-        for panes in group_panes(live, cfg.superbatch):
-            k = len(panes)
-            rows = max(1, 1 << (k - 1).bit_length())  # pow2 bucket, <= K
-            e_max = max(p.num_edges for p in panes)
-            e_pad = max(1, 1 << (e_max - 1).bit_length())
-            src_k = np.zeros((rows, e_pad), np.int32)
-            dst_k = np.zeros((rows, e_pad), np.int32)
-            mask_k = np.zeros((rows, e_pad), bool)
-            val_k = None
-            if any(p.val is not None for p in panes):
-                proto = next(p.val for p in panes if p.val is not None)
-                val_k = jax.tree.map(
-                    lambda a: np.zeros((rows, e_pad) + a.shape[1:], a.dtype),
-                    proto,
-                )
-            for i, pane in enumerate(panes):
-                n = pane.num_edges
-                src_k[i, :n] = pane.src
-                dst_k[i, :n] = pane.dst
-                mask_k[i, :n] = True
-                if val_k is not None and pane.val is not None:
+        groups = group_panes(live, cfg.superbatch)
+        depth = async_exec.resolve_depth(cfg)
+        if depth > 0:
+            # async pipeline: row assembly on the prefetcher's pack thread
+            # (ingest-pool parallel row fill), transfer on its second,
+            # folds dispatched here without waiting — same executables and
+            # per-window partials as the inline path below
+            from gelly_streaming_tpu.io import wire as wire_mod
 
-                    def fill(buf, a):
-                        buf[i, : len(a)] = a
-                        return buf
+            def prep(panes):
+                return tuple(panes), self._assemble_superpane_rows(panes)
 
-                    val_k = jax.tree.map(fill, val_k, pane.val)
+            with wire_mod.Prefetcher(groups, prep, depth=depth + 1) as pf:
+                # hot-loop: superpane dispatch (no per-group host syncs)
+                for panes, dev in pf:
+                    src_d, dst_d, val_d, mask_d = dev
+                    fold = self._superpane_fold_fn(cfg, val_d is not None)
+                    states = fold(src_d, dst_d, val_d, mask_d)
+                    for i, pane in enumerate(panes):
+                        yield pane, jax.tree.map(lambda a, i=i: a[i], states)
+                # hot-loop-end
+            return
+        for panes in groups:
+            src_k, dst_k, val_k, mask_k = self._assemble_superpane_rows(panes)
             fold = self._superpane_fold_fn(cfg, val_k is not None)
             states = fold(
                 jnp.asarray(src_k),
@@ -931,6 +1030,39 @@ class SummaryAggregation:
             )
             for i, pane in enumerate(panes):
                 yield pane, jax.tree.map(lambda a, i=i: a[i], states)
+
+    def _assemble_superpane_rows(self, panes):
+        """Host assembly of a pane group's [rows, E_pad] fold layout (the
+        transfer layout `_superpane_fold_fn` consumes): numpy
+        (src_k, dst_k, val_k | None, mask_k).  Row filling shards across the
+        ingest worker pool (io/ingest.fill_pane_rows_into) — one row per
+        pane, each worker writing its slice in place."""
+        from gelly_streaming_tpu.io import ingest as ingest_mod
+
+        k = len(panes)
+        rows = max(1, 1 << (k - 1).bit_length())  # pow2 bucket, <= K
+        e_max = max(p.num_edges for p in panes)
+        e_pad = max(1, 1 << (e_max - 1).bit_length())
+        src_k = np.zeros((rows, e_pad), np.int32)
+        dst_k = np.zeros((rows, e_pad), np.int32)
+        mask_k = np.zeros((rows, e_pad), bool)
+        ingest_mod.fill_pane_rows_into(panes, src_k, dst_k, mask_k)
+        val_k = None
+        if any(p.val is not None for p in panes):
+            proto = next(p.val for p in panes if p.val is not None)
+            val_k = jax.tree.map(
+                lambda a: np.zeros((rows, e_pad) + a.shape[1:], a.dtype),
+                proto,
+            )
+            for i, pane in enumerate(panes):
+                if pane.val is not None:
+
+                    def fill(buf, a):
+                        buf[i, : len(a)] = a
+                        return buf
+
+                    val_k = jax.tree.map(fill, val_k, pane.val)
+        return src_k, dst_k, val_k, mask_k
 
     def _mesh_runner(self, cfg: StreamConfig) -> "MeshAggregationRunner":
         """Cached sharded runner for cfg.num_shards (compiled steps persist)."""
@@ -950,6 +1082,7 @@ class SummaryAggregation:
         checkpoint_path: Optional[str],
         restore: bool,
         unwrap: bool = False,
+        release: Optional[Callable] = None,
     ) -> Iterator[tuple]:
         """The Merger: running merge + emission + positional checkpointing
         (SummaryAggregation.java:93-135), shared by the simulated and mesh
@@ -961,7 +1094,31 @@ class SummaryAggregation:
         the iterator yields (pane, payload) pairs — position/window logic
         reads the pane, the payload goes to ``fold_pane`` (the mesh runner
         attaches prefetched device buffers this way).
+
+        With ``cfg.async_windows`` > 0 the loop runs in its asynchronous
+        form (core/async_exec.async_merge_loop): folds dispatch without
+        waiting and emissions/checkpoints resolve through a completion
+        queue in window order — same record sequence and recovery
+        semantics, minus the per-window host round trip.  ``release``
+        (async only) recycles a window's transfer arenas once its fold is
+        known complete.
         """
+        from gelly_streaming_tpu.core import async_exec
+
+        depth = async_exec.resolve_depth(cfg)
+        if depth > 0:
+            yield from async_exec.async_merge_loop(
+                self,
+                cfg,
+                panes,
+                fold_pane,
+                checkpoint_path,
+                restore,
+                unwrap=unwrap,
+                depth=depth,
+                release=release,
+            )
+            return
         running = None
         start_after = -1
         global_done = False

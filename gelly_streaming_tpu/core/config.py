@@ -77,6 +77,17 @@ class StreamConfig:
     # 0 = auto: the GELLY_INGEST_WORKERS env var when set, else the
     # process's usable core count.  1 = single-threaded.
     ingest_workers: int = 0
+    # Asynchronous window pipeline (core/async_exec.py): keep up to this
+    # many closed windows in flight end to end — pane packing on the
+    # prefetcher's pack thread (ingest-pool assisted), transfers on its
+    # second thread, device folds dispatched without waiting, and window
+    # emissions resolved through a completion queue drained in window-id
+    # order, so the record sequence (and checkpoint semantics) is
+    # bit-identical to the synchronous path (pinned by
+    # tests/test_async_windows.py).  0 = synchronous lockstep (the
+    # historical behavior and the equivalence oracle); when left at 0 the
+    # GELLY_ASYNC_WINDOWS env var may switch it on process-wide.
+    async_windows: int = 0
     # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
     # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
     # values trail the watermark behind max seen time by the bound, holding
@@ -112,6 +123,8 @@ class StreamConfig:
             raise ValueError("superbatch must be >= 0")
         if self.ingest_workers < 0:
             raise ValueError("ingest_workers must be >= 0")
+        if self.async_windows < 0:
+            raise ValueError("async_windows must be >= 0")
         if self.vertex_capacity <= 0:
             raise ValueError("vertex_capacity must be positive")
         if self.num_shards <= 0:

@@ -102,6 +102,36 @@ class SnapshotStream:
                 val = jax.tree.map(lambda a: np.concatenate([a, a]), val)
         return src, dst, val
 
+    def _padded_pane_edges(self, pane: WindowPane):
+        """Direction semantics + the shared pow2 pad of one pane's edges.
+
+        Returns numpy ``(src, dst, val | None, mask)`` in the exact layout
+        `_build_buckets_j` consumes, or None for an edge-less pane.  ONE
+        implementation feeds both the synchronous `_neighborhood_panes`
+        and the async `_kernel_chunks_async` prepare stage, so the two
+        paths' pad policy (and therefore their compiled shapes and chunk
+        sequences) cannot diverge.
+        """
+        src, dst, val = self._directed_edges(pane)
+        n = len(src)
+        if n == 0:
+            return None
+        e_pad = max(1, 1 << (n - 1).bit_length())
+        mask = np.zeros((e_pad,), bool)
+        mask[:n] = True
+
+        def pad(a):
+            out = np.zeros((e_pad,) + a.shape[1:], a.dtype)
+            out[:n] = a
+            return out
+
+        return (
+            pad(src.astype(np.int32)),
+            pad(dst.astype(np.int32)),
+            None if val is None else jax.tree.map(pad, val),
+            mask,
+        )
+
     def _neighborhood_panes(self) -> Iterator[Neighborhoods]:
         """Device-built, degree-bucketed neighborhoods per closed pane.
 
@@ -112,23 +142,14 @@ class SnapshotStream:
         """
         panes = self._panes()
         for pane in panes:
-            src, dst, val = self._directed_edges(pane)
-            n = len(src)
-            if n == 0:
+            padded = self._padded_pane_edges(pane)
+            if padded is None:
                 continue
-            e_pad = max(1, 1 << (n - 1).bit_length())
-            mask = np.zeros((e_pad,), bool)
-            mask[:n] = True
-
-            def pad(a):
-                out = np.zeros((e_pad,) + a.shape[1:], a.dtype)
-                out[:n] = a
-                return out
-
+            src_p, dst_p, val_p, mask = padded
             buckets = _build_buckets_j(
-                jnp.asarray(pad(src.astype(np.int32))),
-                jnp.asarray(pad(dst.astype(np.int32))),
-                None if val is None else jax.tree.map(lambda a: jnp.asarray(pad(a)), val),
+                jnp.asarray(src_p),
+                jnp.asarray(dst_p),
+                None if val_p is None else jax.tree.map(jnp.asarray, val_p),
                 jnp.asarray(mask),
             )
             for bkt in buckets:
@@ -167,18 +188,9 @@ class SnapshotStream:
             self._kernel_caches[bucket_kernel] = entry
         return entry
 
-    def _kernel_chunks(self, bucket_kernel, needs_vals: bool, extra=None):
-        """Run ``bucket_kernel(keys, nbrs, vals, valid[, extra])`` over every
-        neighborhood bucket; yield host chunks
-        ``(window_id, keys [n], out pytree of [n, ...], n)`` of real rows.
-
-        ``extra`` is an optional per-shard operand pytree with leading shard
-        axis ([S, ...] — e.g. ring feature blocks); on the single-device path
-        its [0] slice is passed.
-        """
-        if self._use_mesh():
-            yield from self._kernel_chunks_mesh(bucket_kernel, needs_vals, extra)
-            return
+    def _jit_kernel(self, bucket_kernel, extra=None):
+        """The cached single-device jitted bucket kernel (per-kernel cache,
+        surviving OutputStream re-runs — see `_kernel_cache`)."""
         cache = self._kernel_cache(bucket_kernel)
         kernel = cache.get("jit")
         if kernel is None:
@@ -190,6 +202,35 @@ class SnapshotStream:
                     lambda k, nb, v, vd: bucket_kernel(k, nb, v, vd, x0)
                 )
             cache["jit"] = kernel
+        return kernel
+
+    def _kernel_chunks(self, bucket_kernel, needs_vals: bool, extra=None):
+        """Run ``bucket_kernel(keys, nbrs, vals, valid[, extra])`` over every
+        neighborhood bucket; yield host chunks
+        ``(window_id, keys [n], out pytree of [n, ...], n)`` of real rows.
+
+        ``extra`` is an optional per-shard operand pytree with leading shard
+        axis ([S, ...] — e.g. ring feature blocks); on the single-device path
+        its [0] slice is passed.
+
+        With ``cfg.async_windows`` > 0 the single-device path runs on the
+        asynchronous window pipeline (core/async_exec.py): pane padding on
+        the pack thread, transfers overlapped, kernel dispatches
+        non-blocking, and the per-pane host materialization rides the
+        completion queue — same chunk sequence, no per-window RTT.
+        """
+        if self._use_mesh():
+            yield from self._kernel_chunks_mesh(bucket_kernel, needs_vals, extra)
+            return
+        from gelly_streaming_tpu.core import async_exec
+
+        depth = async_exec.resolve_depth(self._stream.cfg)
+        if depth > 0:
+            yield from self._kernel_chunks_async(
+                bucket_kernel, needs_vals, extra, depth
+            )
+            return
+        kernel = self._jit_kernel(bucket_kernel, extra)
         for hood in self._neighborhood_panes():
             if needs_vals and hood.vals is None:
                 raise ValueError(_NEEDS_VALUES_MSG)
@@ -206,6 +247,65 @@ class SnapshotStream:
                 jax.tree.map(lambda a: np.asarray(a)[:n], out),
                 n,
             )
+
+    def _kernel_chunks_async(
+        self, bucket_kernel, needs_vals: bool, extra, depth: int
+    ):
+        """`_kernel_chunks` on the async window pipeline (single device).
+
+        Per pane: direction handling + pow2 padding on the pack thread,
+        device transfer on the second thread, bucket build + kernel
+        dispatched without waiting (with the result downloads started), and
+        the host-side slicing deferred to the completion-queue drain.  The
+        chunk sequence — window order, bucket order, real-row slicing — is
+        identical to the synchronous path.
+        """
+        from gelly_streaming_tpu.core import async_exec
+
+        kernel = self._jit_kernel(bucket_kernel, extra)
+
+        def prepare(pane: WindowPane):
+            padded = self._padded_pane_edges(pane)
+            if padded is None:
+                return (pane.window_id, 0), None
+            return (pane.window_id, 1), padded
+
+        def dispatch(meta, dev):
+            if dev is None:
+                return None
+            src_d, dst_d, val_d, mask_d = dev
+            if needs_vals and val_d is None:
+                raise ValueError(_NEEDS_VALUES_MSG)
+            handles = []
+            for bkt in _build_buckets_j(src_d, dst_d, val_d, mask_d):
+                out = kernel(bkt.keys, bkt.nbrs, bkt.vals, bkt.valid)
+                async_exec.start_host_fetch((bkt.keys, bkt.num_keys, out))
+                handles.append((bkt.keys, bkt.num_keys, out))
+            return handles
+
+        def finish(meta, handles):
+            if handles is None:
+                return []
+            wid = meta[0]
+            chunks = []
+            for keys, num_keys, out in handles:
+                nk = int(np.asarray(num_keys))
+                if nk == 0:
+                    continue
+                chunks.append(
+                    (
+                        wid,
+                        np.asarray(keys)[:nk],
+                        jax.tree.map(lambda a: np.asarray(a)[:nk], out),
+                        nk,
+                    )
+                )
+            return chunks
+
+        for chunks in async_exec.pipelined(
+            self._panes(), prepare, dispatch, finish, depth
+        ):
+            yield from chunks
 
     def _mesh_step(self, cache, bucket_kernel, cap, has_val, extra_proto):
         key = (cap, has_val)

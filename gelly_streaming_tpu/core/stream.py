@@ -632,9 +632,12 @@ class EdgeStream:
         with wire.WirePrefetcher(
             full_batches(), width, depth=cfg.prefetch_depth
         ) as pf:
+            # hot-loop: fused kernel-stream dispatch (downloads ride
+            # prefetch_to_host's async-copy queue, never this loop)
             for buf, _ in pf:
                 carry, outs = wire_j(carry, buf, bs, width)
                 yield outs
+            # hot-loop-end
         rem = len(src) - n_full * bs
         if rem:
             tail = EdgeBatch.from_arrays(

@@ -302,6 +302,39 @@ def pack_rows_into(
     _run_parallel([lambda j=j: one(j) for j in range(group)], workers)
 
 
+def fill_pane_rows_into(
+    panes,
+    src_k: np.ndarray,
+    dst_k: np.ndarray,
+    mask_k: np.ndarray,
+    workers: int = 0,
+) -> None:
+    """Fill row ``i`` of the [K, E_pad] fold arenas with pane ``i``'s edges.
+
+    The timed-pane extension of the arena pattern: ``src_k``/``dst_k``/
+    ``mask_k`` are the exact transfer layout the superpane fold consumes
+    (row per window, mask True on the real prefix), and each row fills in
+    place on the shared ingest pool — no per-pane intermediate copies.
+    Rows beyond ``len(panes)`` are left as the caller initialized them
+    (zeroed = fully masked padding).
+    """
+
+    def one(i: int, pane) -> None:
+        n = pane.num_edges
+        src_k[i, :n] = pane.src
+        dst_k[i, :n] = pane.dst
+        mask_k[i, :n] = True
+
+    workers = resolve_workers(workers)
+    if workers <= 1 or len(panes) <= 1:
+        for i, p in enumerate(panes):
+            one(i, p)
+        return
+    _run_parallel(
+        [lambda i=i, p=p: one(i, p) for i, p in enumerate(panes)], workers
+    )
+
+
 def parallel_pack_stream(
     src: np.ndarray,
     dst: np.ndarray,

@@ -412,6 +412,9 @@ class Prefetcher:
     def __init__(self, items: Iterable, prepare, device=None, depth: int = 4):
         import jax
 
+        from ..utils import metrics as _metrics
+
+        _metrics.pipeline_high_water("pipeline_prefetch_depth", depth)
         self._prepare = prepare
         self._device = device if device is not None else jax.devices()[0]
         self._midq: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -444,11 +447,23 @@ class Prefetcher:
         return self._SENTINEL
 
     def _run_pack(self, it: Iterator):
+        import time as _time
+
+        from ..utils import metrics as _metrics
+
         try:
             for item in it:
                 if self._stop.is_set():
                     return
-                if not self._put(self._midq, self._prepare(item)):
+                prepared = self._prepare(item)
+                t0 = _time.perf_counter()
+                ok = self._put(self._midq, prepared)
+                # pack-stage stall: downstream (transfer/consumer)
+                # backpressure held the packed item out of the queue
+                _metrics.pipeline_add(
+                    "pipeline_pack_stall_s", _time.perf_counter() - t0
+                )
+                if not ok:
                     return
         except BaseException as e:  # surfaced on the consumer thread
             if self._error is None:  # keep the FIRST failure (root cause)
@@ -457,11 +472,21 @@ class Prefetcher:
             self._put(self._midq, self._SENTINEL)
 
     def _run_put(self):
+        import time as _time
+
         import jax
+
+        from ..utils import metrics as _metrics
 
         try:
             while True:
+                t0 = _time.perf_counter()
                 got = self._get(self._midq)
+                # transfer-stage stall: the transfer thread starved waiting
+                # for the pack stage (utils.metrics pipeline counters)
+                _metrics.pipeline_add(
+                    "pipeline_transfer_stall_s", _time.perf_counter() - t0
+                )
                 if got is self._SENTINEL:
                     return
                 meta, host = got

@@ -352,6 +352,38 @@ def window_triangles(
     reference.
     """
     validate_slide(window_ms, slide_ms)
+    from gelly_streaming_tpu.core import async_exec
+
+    depth = async_exec.resolve_depth(stream.cfg)
+    if depth > 0 and stream.cfg.superbatch <= 1:
+        # asynchronous window pipeline (core/async_exec.py): pane
+        # pack/compaction on the pack thread, uploads on the transfer
+        # thread, counts dispatched without waiting and fetched through the
+        # completion queue in window order — the deep generalization of the
+        # one-deep submit/finish overlap below, with cfg.async_windows
+        # panes in flight.  Counts are identical to the sequential path
+        # (pinned by tests/test_async_windows.py).
+        def records_async() -> Iterator[tuple]:
+            def prepare(pane):
+                meta, arrays = _pane_prepare((pane.src, pane.dst))
+                return (pane.max_timestamp, meta), arrays
+
+            def dispatch(meta, dev):
+                return _pane_dispatch(meta[1], dev)
+
+            def finish(meta, handle):
+                return (_pane_triangle_finish(handle), meta[0])
+
+            yield from async_exec.pipelined(
+                windowed_panes(stream, window_ms, slide_ms),
+                prepare,
+                dispatch,
+                finish,
+                depth,
+                prefetch_depth=max(2, depth),
+            )
+
+        return OutputStream(records_async)
 
     if stream.cfg.superbatch > 1:
         # superbatch dispatch coalescing: up to K panes count in ONE

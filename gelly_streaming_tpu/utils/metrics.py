@@ -10,6 +10,7 @@ optional jax.profiler trace context for device-level inspection.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import List, Optional
 
@@ -72,6 +73,77 @@ class WindowLatencyRecorder:
     @property
     def p50_ms(self) -> float:
         return self.percentile(50)
+
+
+# ---------------------------------------------------------------------------
+# Async-window pipeline occupancy (core/async_exec.py + io/wire.Prefetcher).
+# Process-global like the compile-cache counters: the pipeline spans several
+# threads (pack, transfer, dispatch/drain), so per-object counters would be
+# invisible to the bench's single JSON report.
+
+
+_PIPE_LOCK = threading.Lock()
+
+
+def _pipeline_zero() -> dict:
+    return {
+        # windows dispatched-but-undrained at once (completion-queue length)
+        "pipeline_inflight_high_water": 0,
+        # seconds the pack stage sat blocked (arena backpressure)
+        "pipeline_pack_stall_s": 0.0,
+        # seconds the transfer stage waited on the pack stage for input
+        "pipeline_transfer_stall_s": 0.0,
+        # seconds the dispatch loop waited on the prefetcher for input
+        "pipeline_dispatch_stall_s": 0.0,
+        # seconds the completion-queue drain spent materializing results
+        "pipeline_drain_stall_s": 0.0,
+        # deepest configured prefetch queue seen (transfers in flight bound)
+        "pipeline_prefetch_depth": 0,
+        "pipeline_windows_dispatched": 0,
+        "pipeline_windows_drained": 0,
+    }
+
+
+_PIPELINE = _pipeline_zero()
+
+
+def pipeline_add(key: str, amount: float) -> None:
+    """Accumulate a pipeline counter (thread-safe; hot-path cheap)."""
+    with _PIPE_LOCK:
+        _PIPELINE[key] += amount
+
+
+def pipeline_high_water(key: str, value: float) -> None:
+    """Raise a pipeline high-water mark to ``value`` if it is higher."""
+    with _PIPE_LOCK:
+        if value > _PIPELINE[key]:
+            _PIPELINE[key] = value
+
+
+def pipeline_stats() -> dict:
+    """Process-wide async-window pipeline occupancy counters: in-flight
+    window high-water mark, per-stage stall seconds (pack / transfer /
+    dispatch / drain), prefetcher queue depth, and dispatched/drained window
+    counts.  Reported by bench.py next to ``compile_cache_stats``."""
+    with _PIPE_LOCK:
+        out = dict(_PIPELINE)
+    out["pipeline_pack_stall_s"] = round(out["pipeline_pack_stall_s"], 4)
+    out["pipeline_transfer_stall_s"] = round(
+        out["pipeline_transfer_stall_s"], 4
+    )
+    out["pipeline_dispatch_stall_s"] = round(
+        out["pipeline_dispatch_stall_s"], 4
+    )
+    out["pipeline_drain_stall_s"] = round(out["pipeline_drain_stall_s"], 4)
+    return out
+
+
+def reset_pipeline_stats() -> None:
+    """Zero the pipeline occupancy counters (call before a measurement
+    window, read ``pipeline_stats`` after)."""
+    global _PIPELINE
+    with _PIPE_LOCK:
+        _PIPELINE = _pipeline_zero()
 
 
 def compile_cache_stats() -> dict:

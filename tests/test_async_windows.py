@@ -1,0 +1,461 @@
+"""Async-vs-sync window pipeline equivalence (ISSUE 2).
+
+``cfg.async_windows`` switches the windowed plane onto the asynchronous
+pipeline (core/async_exec.py): pane packing on the prefetcher's pack thread,
+overlapped transfers, non-blocking fold dispatch, and a completion queue
+drained in window order.  The synchronous path (``async_windows=0``) is the
+equivalence oracle: every test here runs both and asserts identical
+emission sequences — plus restore/SIGKILL recovery parity, a retrace guard,
+and the engine's own unit behaviors.
+
+The threaded tests carry ``timeout_cap`` (tests/conftest.py): a hung
+completion queue must fail the test, not wedge tier-1.
+"""
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core import async_exec
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.core.types import EdgeBatch, EdgeDirection
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+from gelly_streaming_tpu.library.triangles import window_triangles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = StreamConfig(vertex_capacity=64, max_degree=16)
+ASYNC = dataclasses.replace(CFG, async_windows=3)
+
+pytestmark = pytest.mark.timeout_cap(300)
+
+
+def _timed_edges(n=240, tmax=2400, seed=0, valued=False):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, 64, n)
+    dst = rng.integers(0, 64, n)
+    t = np.sort(rng.integers(0, tmax, n))
+    if valued:
+        return [
+            (int(a), int(b), float(a + b), int(ts))
+            for a, b, ts in zip(src, dst, t)
+        ]
+    return [(int(a), int(b), 0, int(ts)) for a, b, ts in zip(src, dst, t)]
+
+
+def _stream(cfg, edges, batch_size=16):
+    return EdgeStream.from_collection(
+        edges, cfg, batch_size=batch_size, with_time=True
+    )
+
+
+def _cc(cfg, edges, window_ms=100, **kw):
+    return [
+        str(r[0])
+        for r in ConnectedComponents(window_ms=window_ms)
+        .run(_stream(cfg, edges), **kw)
+        .collect()
+    ]
+
+
+# ---------------------------------------------------------------------------
+# emission-sequence equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_event_time_windows_match_sync():
+    edges = _timed_edges()
+    sync = _cc(CFG, edges)
+    assert sync == _cc(ASYNC, edges)
+    assert len(sync) >= 10
+
+
+def test_ingestion_pane_windows_match_sync():
+    edges = [(a, b, 0, 0) for a, b, _v, _t in _timed_edges(n=200, seed=1)]
+    untimed = [e[:3] for e in edges]
+
+    def run(cfg):
+        s = EdgeStream.from_collection(untimed, cfg, batch_size=16)
+        return [str(r[0]) for r in ConnectedComponents().run(s).collect()]
+
+    base = dataclasses.replace(CFG, ingest_window_edges=48)
+    sync = run(base)
+    assert sync == run(dataclasses.replace(base, async_windows=3))
+    assert len(sync) >= 4
+
+
+def test_empty_and_partial_windows_match_sync():
+    # sparse timestamps: long gaps leave windows empty; singleton windows
+    # exercise the 1-edge pad bucket
+    edges = [
+        (1, 2, 0, 10),
+        (3, 4, 0, 950),  # window 9 after an 8-window gap
+        (2, 3, 0, 2000),  # singleton window 20
+        (5, 6, 0, 2010),
+        (6, 7, 0, 5000),  # trailing singleton after another gap
+    ]
+    sync = _cc(CFG, edges)
+    assert sync == _cc(ASYNC, edges)
+
+
+def test_valued_stream_windows_match_sync():
+    edges = _timed_edges(valued=True, seed=3)
+    sync = _cc(CFG, edges)
+    assert sync == _cc(ASYNC, edges)
+
+
+def test_superbatch_async_matches_sync():
+    edges = _timed_edges(seed=4)
+    base = _cc(CFG, edges)
+    sb = dataclasses.replace(CFG, superbatch=4)
+    assert _cc(sb, edges) == base
+    assert _cc(dataclasses.replace(sb, async_windows=3), edges) == base
+
+
+def test_mesh_plane_async_matches_sync():
+    edges = _timed_edges(seed=5)
+    mesh = dataclasses.replace(CFG, num_shards=4)
+    sync = _cc(mesh, edges, window_ms=200)
+    assert sync == _cc(
+        dataclasses.replace(mesh, async_windows=3), edges, window_ms=200
+    )
+    assert len(sync) >= 5
+
+
+def test_late_records_routed_identically():
+    # out-of-order stream with a bounded watermark: later-than-bound records
+    # go to the late sink in both modes, and the pane emissions agree
+    rng = np.random.default_rng(6)
+    t = rng.integers(0, 1200, 200)
+    edges = [
+        (int(a), int(b), 0, int(ts))
+        for a, b, ts in zip(
+            rng.integers(0, 64, 200), rng.integers(0, 64, 200), t
+        )
+    ]
+    base = dataclasses.replace(CFG, out_of_orderness_ms=150)
+
+    def run(cfg):
+        late = []
+
+        def sink(src, dst, val, time):
+            late.extend(
+                (int(s), int(d), int(tt)) for s, d, tt in zip(src, dst, time)
+            )
+
+        stream = _stream(cfg, edges).on_late(sink)
+        recs = [
+            str(r[0])
+            for r in ConnectedComponents(window_ms=100).run(stream).collect()
+        ]
+        return recs, late
+
+    sync_recs, sync_late = run(base)
+    async_recs, async_late = run(dataclasses.replace(base, async_windows=3))
+    assert sync_recs == async_recs
+    assert sync_late == async_late
+    assert len(sync_late) > 0, "fixture must actually produce late records"
+
+
+def test_window_triangles_async_matches_sync():
+    edges = _timed_edges(n=300, seed=7)
+    sync = window_triangles(_stream(CFG, edges), 200).collect()
+    assert sync == window_triangles(_stream(ASYNC, edges), 200).collect()
+    assert any(c > 0 for c, _ in sync)
+
+
+def test_sliding_window_triangles_async_matches_sync():
+    edges = _timed_edges(n=300, seed=8)
+    sync = window_triangles(_stream(CFG, edges), 400, slide_ms=200).collect()
+    assert (
+        sync
+        == window_triangles(_stream(ASYNC, edges), 400, slide_ms=200).collect()
+    )
+
+
+def test_snapshot_plane_async_matches_sync():
+    edges = _timed_edges(n=200, seed=9, valued=True)
+    sync = (
+        _stream(CFG, edges)
+        .slice(200, EdgeDirection.OUT)
+        .reduce_on_edges(lambda a, b: a + b)
+        .collect()
+    )
+    asyn = (
+        _stream(ASYNC, edges)
+        .slice(200, EdgeDirection.OUT)
+        .reduce_on_edges(lambda a, b: a + b)
+        .collect()
+    )
+    assert sync == asyn
+    assert len(sync) > 20
+
+
+def test_async_error_still_delivers_prior_windows():
+    """A source failure mid-stream: windows closed before the failure are
+    delivered (they were in the sequential path), then the error surfaces."""
+    rng = np.random.default_rng(10)
+
+    def make(cfg):
+        def factory():
+            for i in range(8):
+                if i == 5:
+                    raise RuntimeError("source died")
+                yield EdgeBatch.from_arrays(
+                    rng.integers(0, 64, 16).astype(np.int32),
+                    rng.integers(0, 64, 16).astype(np.int32),
+                    time=np.full(16, i * 100 + 50),
+                )
+
+        return EdgeStream.from_batches(factory, cfg)
+
+    def run(cfg):
+        recs = []
+        with pytest.raises(RuntimeError, match="source died"):
+            for r in ConnectedComponents(window_ms=100).run(make(cfg)):
+                recs.append(str(r[0]))
+        return recs
+
+    rng = np.random.default_rng(10)
+    sync = run(CFG)
+    rng = np.random.default_rng(10)
+    assert run(ASYNC) == sync
+    assert len(sync) == 4  # windows 0..3 closed before batch 5's failure
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/restore parity
+# ---------------------------------------------------------------------------
+
+EDGES_T = [
+    (1, 2, 0, 10),
+    (3, 4, 0, 110),
+    (2, 3, 0, 210),
+    (5, 6, 0, 310),
+]
+
+
+def test_checkpoint_file_matches_sync(tmp_path):
+    """A full checkpointed run leaves a bit-identical final snapshot."""
+    sync = _cc(CFG, EDGES_T, checkpoint_path=str(tmp_path / "s"))
+    asyn = _cc(ASYNC, EDGES_T, checkpoint_path=str(tmp_path / "a"))
+    assert sync == asyn
+    zs = np.load(str(tmp_path / "s") + ".npz")
+    za = np.load(str(tmp_path / "a") + ".npz")
+    assert sorted(zs.files) == sorted(za.files)
+    for k in zs.files:
+        assert np.array_equal(zs[k], za[k]), k
+
+
+def test_async_resumes_from_sync_snapshot(tmp_path):
+    """Snapshots are cross-compatible: sync writes, async resumes (and the
+    other way around) — both equal the uninterrupted run."""
+    full = _cc(CFG, EDGES_T)
+    ck1 = str(tmp_path / "x")
+    _cc(CFG, EDGES_T[:2], checkpoint_path=ck1)
+    resumed = _cc(ASYNC, EDGES_T[2:], checkpoint_path=ck1)
+    assert resumed[-1] == full[-1]
+    ck2 = str(tmp_path / "y")
+    _cc(ASYNC, EDGES_T[:2], checkpoint_path=ck2)
+    resumed2 = _cc(CFG, EDGES_T[2:], checkpoint_path=ck2)
+    assert resumed2[-1] == full[-1]
+
+
+_CHILD = textwrap.dedent(
+    """
+    import os, signal, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from gelly_streaming_tpu.core.aggregation import SummaryBulkAggregation
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    class EdgeCount(SummaryBulkAggregation):
+        # NON-idempotent fold: re-folding any pane after a resume would
+        # overcount, so the final value proves exactly-once state
+        def initial_state(self, cfg):
+            return jnp.zeros((), jnp.int32)
+
+        def update(self, state, src, dst, val, mask):
+            return state + jnp.sum(mask.astype(jnp.int32))
+
+        def combine(self, a, b):
+            return a + b
+
+    kill_after = int(os.environ.get("KILL_AFTER_SAVES", "0"))
+    if kill_after:
+        import gelly_streaming_tpu.utils.checkpoint as ckpt
+        real = ckpt.save_state
+        n = [0]
+        def hooked(p, s):
+            real(p, s)
+            n[0] += 1
+            if n[0] >= kill_after:
+                os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, no atexit
+        ckpt.save_state = hooked
+
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 64, 1024).astype(np.int32)
+    dst = rng.integers(0, 64, 1024).astype(np.int32)
+    cfg = StreamConfig(
+        vertex_capacity=64,
+        batch_size=96,
+        # 128 % 96 != 0 -> the WINDOWED runtime (not the wire fast path)
+        ingest_window_edges=128,
+        async_windows=int(os.environ.get("CHILD_ASYNC", "0")),
+    )
+    out = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(EdgeCount(), checkpoint_path={ckpt_path!r})
+        .collect()
+    )
+    print("WINDOWS", len(out), "FINAL", int(out[-1][0]))
+    """
+)
+
+
+def _run_child(script, ckpt_path, env_extra):
+    env = dict(os.environ, **env_extra)
+    return subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True, timeout=300
+    )
+
+
+def test_sigkill_mid_stream_positions_match_sync(tmp_path):
+    """SIGKILL the async windowed run mid-stream: the surviving snapshot's
+    position/summary equal a sync run killed at the same save, and the async
+    resume completes the non-idempotent count exactly."""
+    ck_async = str(tmp_path / "ck_async")
+    ck_sync = str(tmp_path / "ck_sync")
+    s_async = tmp_path / "child_a.py"
+    s_sync = tmp_path / "child_s.py"
+    s_async.write_text(_CHILD.format(repo=REPO, ckpt_path=ck_async))
+    s_sync.write_text(_CHILD.format(repo=REPO, ckpt_path=ck_sync))
+
+    first = _run_child(
+        s_async, ck_async, {"KILL_AFTER_SAVES": "3", "CHILD_ASYNC": "3"}
+    )
+    assert first.returncode == -signal.SIGKILL, (
+        first.returncode,
+        first.stdout,
+        first.stderr,
+    )
+    ref = _run_child(
+        s_sync, ck_sync, {"KILL_AFTER_SAVES": "3", "CHILD_ASYNC": "0"}
+    )
+    assert ref.returncode == -signal.SIGKILL
+
+    za = np.load(ck_async + ".npz")
+    zs = np.load(ck_sync + ".npz")
+    assert sorted(za.files) == sorted(zs.files)
+    for k in za.files:
+        assert np.array_equal(za[k], zs[k]), (
+            f"checkpoint field {k} diverged between async and sync kills"
+        )
+
+    # resume the async run from its snapshot: exact count, no re-fold
+    second = _run_child(s_async, ck_async, {"CHILD_ASYNC": "3"})
+    assert second.returncode == 0, second.stderr.decode()
+    assert b"FINAL 1024" in second.stdout, second.stdout
+
+
+# ---------------------------------------------------------------------------
+# retrace guard + engine units
+# ---------------------------------------------------------------------------
+
+
+def test_async_windows_zero_recompiles():
+    """Async mode preserves the executable-cache guarantee: a second run
+    over same-shape windows mints zero recompiles."""
+    from gelly_streaming_tpu.core import compile_cache
+
+    edges = _timed_edges(n=320, tmax=2000, seed=11)
+
+    def run():
+        return _cc(ASYNC, edges)
+
+    first = run()  # compiles land here
+    compile_cache.reset_stats()
+    assert run() == first
+    assert compile_cache.stats()["recompiles"] == 0
+
+
+def test_resolve_depth_precedence(monkeypatch):
+    monkeypatch.delenv("GELLY_ASYNC_WINDOWS", raising=False)
+    assert async_exec.resolve_depth(StreamConfig()) == 0
+    monkeypatch.setenv("GELLY_ASYNC_WINDOWS", "5")
+    assert async_exec.resolve_depth(StreamConfig()) == 5
+    monkeypatch.setenv("GELLY_ASYNC_WINDOWS", "nonsense")
+    assert async_exec.resolve_depth(StreamConfig()) == 0
+    # explicit config wins over the env var
+    monkeypatch.setenv("GELLY_ASYNC_WINDOWS", "5")
+    assert async_exec.resolve_depth(ASYNC) == 3
+
+
+def test_async_windows_validation():
+    with pytest.raises(ValueError):
+        StreamConfig(async_windows=-1)
+
+
+def test_env_var_switches_pipeline_on(monkeypatch):
+    """GELLY_ASYNC_WINDOWS alone (config untouched) runs the async plane
+    with unchanged emissions."""
+    edges = _timed_edges(seed=12)
+    sync = _cc(CFG, edges)
+    monkeypatch.setenv("GELLY_ASYNC_WINDOWS", "3")
+    assert _cc(CFG, edges) == sync
+
+
+def test_pipeline_metrics_populate():
+    from gelly_streaming_tpu.utils import metrics
+
+    edges = _timed_edges(seed=13)
+    metrics.reset_pipeline_stats()
+    _cc(ASYNC, edges)
+    stats = metrics.pipeline_stats()
+    assert stats["pipeline_windows_dispatched"] > 0
+    assert (
+        stats["pipeline_windows_drained"]
+        == stats["pipeline_windows_dispatched"]
+    )
+    # depth 3 -> the completion queue must actually have filled past 1
+    assert stats["pipeline_inflight_high_water"] >= 2
+    metrics.reset_pipeline_stats()
+    assert metrics.pipeline_stats()["pipeline_windows_dispatched"] == 0
+
+
+def test_arena_pool_recycles_and_caps():
+    pool = async_exec.ArenaPool(per_shape=2)
+    a = pool.acquire((8,), np.int32)
+    a[:] = 7
+    pool.release(a)
+    b = pool.acquire((8,), np.int32)
+    assert b is a, "released arena must be recycled"
+    assert not b.any(), "recycled arena must come back zeroed"
+    c = pool.acquire((8,), np.int32)
+    d = pool.acquire((8,), np.int32)
+    pool.release(b, c, d)  # cap 2: one of the three is dropped
+    assert len(pool._free[((8,), np.dtype(np.int32).str)]) == 2
+    # different shape/dtype classes do not mix
+    e = pool.acquire((8,), bool)
+    assert e.dtype == bool
+
+
+def test_arena_pool_never_blocks():
+    """Regression: the pool must hand out fresh buffers past its retention
+    cap instead of blocking — a blocking pool deadlocks the pack thread
+    against the drain that would release arenas."""
+    pool = async_exec.ArenaPool(per_shape=1)
+    bufs = [pool.acquire((4,), np.int32) for _ in range(16)]
+    assert len({id(b) for b in bufs}) == 16

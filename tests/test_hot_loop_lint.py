@@ -1,0 +1,124 @@
+"""Hot-path sync lint (tier-1): ``# hot-loop`` regions stay free of blocking
+host syncs.
+
+The async window pipeline's invariant (core/async_exec.py) is that dispatch
+loops never wait on the device; a single ``np.asarray`` / ``.item()`` /
+``block_until_ready`` re-introduced into one of those loops silently
+restores the one-RTT-per-window lockstep.  This test pins the invariant over
+the marked regions in ``core/``, ``io/``, and ``library/`` — plus unit-tests
+the checker itself so a broken linter cannot pass vacuously.
+"""
+
+import textwrap
+
+from gelly_streaming_tpu.utils import hot_loop_lint
+
+
+def _lint(src: str):
+    return hot_loop_lint.check_source(textwrap.dedent(src), "probe.py")
+
+
+def test_package_hot_loops_are_sync_free():
+    problems = hot_loop_lint.check_paths(
+        hot_loop_lint.package_hot_loop_paths()
+    )
+    assert problems == [], "\n".join(problems)
+
+
+def test_package_has_marked_regions():
+    """The invariant is only pinned if regions are actually marked: count
+    the ``# hot-loop`` openers across the scanned planes."""
+    import os
+
+    count = 0
+    for root in hot_loop_lint.package_hot_loop_paths():
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, name)) as f:
+                    regions, errors = hot_loop_lint._regions(
+                        f.read().splitlines()
+                    )
+                assert errors == []
+                count += len(regions)
+    assert count >= 5, "expected the async/wire dispatch loops to be marked"
+
+
+def test_detects_np_asarray_in_region():
+    problems = _lint(
+        """
+        import numpy as np
+
+        def f(xs):
+            out = []
+            # hot-loop: probe region
+            for x in xs:
+                out.append(np.asarray(x))
+            # hot-loop-end
+            return out
+        """
+    )
+    assert len(problems) == 1 and "np.asarray()" in problems[0]
+
+
+def test_detects_item_and_block_until_ready():
+    problems = _lint(
+        """
+        import jax
+
+        def f(xs):
+            # hot-loop
+            for x in xs:
+                x.block_until_ready()
+                jax.block_until_ready(x)
+                y = x.item()
+            # hot-loop-end
+        """
+    )
+    assert len(problems) == 3
+
+
+def test_outside_region_and_jnp_asarray_are_clean():
+    problems = _lint(
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(xs):
+            host = np.asarray(xs)  # outside any region: fine
+            # hot-loop
+            dev = [jnp.asarray(x) for x in xs]  # transfer, not a sync
+            # hot-loop-end
+            return host, dev
+        """
+    )
+    assert problems == []
+
+
+def test_hot_loop_ok_allowlists_single_line():
+    problems = _lint(
+        """
+        import numpy as np
+
+        def f(xs):
+            # hot-loop
+            for x in xs:
+                a = np.asarray(x)  # hot-loop-ok: completion-queue drain
+                b = np.asarray(x)
+            # hot-loop-end
+            return a, b
+        """
+    )
+    assert len(problems) == 1
+
+
+def test_unclosed_region_is_an_error():
+    problems = _lint(
+        """
+        def f():
+            # hot-loop
+            return 1
+        """
+    )
+    assert len(problems) == 1 and "never closed" in problems[0]
