@@ -842,6 +842,33 @@ def main():
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"async window bench skipped: {e}", file=sys.stderr)
 
+    # ---- static-analysis attestation: the artifact doubles as a proof the
+    # measured tree passes graftcheck (0 = clean; a positive count means the
+    # bench ran on a tree whose invariants the suite no longer pins)
+    analysis_stats = {}
+    try:
+        from gelly_streaming_tpu import analysis as _analysis
+
+        _aroot = _analysis.package_root()
+        _afindings = _analysis.analyze_paths(
+            [
+                os.path.join(_aroot, d)
+                for d in ("core", "io", "library", "parallel", "utils")
+            ],
+            root=os.path.dirname(_aroot),
+        )
+        _anew, _ = _analysis.apply_baseline(
+            _afindings, _analysis.load_baseline(_analysis.default_baseline_path())
+        )
+        analysis_stats = {"analysis_findings": len(_anew)}
+        _PARTIAL.update(analysis_stats)
+        print(
+            f"graftcheck: {len(_anew)} unsuppressed finding(s)",
+            file=sys.stderr,
+        )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"static-analysis attestation skipped: {e}", file=sys.stderr)
+
     # ---- device-only fold rate + roofline (needs a fresh link: even
     # dispatch RPCs get ~100ms+ latency once the tunnel throttles, so this
     # runs BEFORE the volume drive drains the budget; it costs one buffer) --
@@ -1251,6 +1278,7 @@ def main():
                 **ingest_stats,
                 **cache_guard,
                 **async_stats,
+                **analysis_stats,
             }
         )
     )

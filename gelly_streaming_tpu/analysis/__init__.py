@@ -1,0 +1,303 @@
+"""graftcheck: the runtime's static-analysis pass suite.
+
+PR 1 and PR 2 bought their speedups by adding invariants the type system
+cannot see: every hot ``jax.jit`` site must route through
+``core/compile_cache.py`` or recompiles silently return; donated arena
+buffers (``ArenaPool`` in ``core/async_exec.py``) must not be touched until
+the completion-queue drain; shared pipeline state is mutated from three
+threads behind ad-hoc locks.  The reference leaned on Flink's runtime to
+referee its operator contracts — our TPU-native runtime has no such referee,
+so this package is the referee: an AST-based framework with a pass registry,
+a machine-readable finding format, per-line suppressions, and a JSON
+baseline for grandfathered findings, runnable as
+
+    python -m gelly_streaming_tpu.analysis --paths core io library parallel
+
+Passes (one module each, registered on import):
+
+  #0 ``hot-loop``        HOTSYNC/HOTMARK — no blocking host syncs inside
+                         ``# hot-loop`` regions (migrated from
+                         utils/hot_loop_lint.py; that module now re-exports).
+  #1 ``jit-discipline``  RAWJIT — raw ``jax.jit`` outside compile_cache.py
+                         bypasses the AOT executable cache + retrace guard.
+  #2 ``donation-safety`` DONATE — reads of names donated to a cached
+                         executable (or handed off arena buffers) before the
+                         sanctioned drain point (``# arena-live-until: drain``).
+  #3 ``lock-discipline`` UNGUARDED — attributes/globals annotated
+                         ``# guarded-by: <lock>`` accessed outside
+                         ``with <lock>:`` (or a ``# single-thread:`` region).
+  #4 ``trace-safety``    TRACEIF/TRACECAST — Python control flow on traced
+                         parameters and int()/bool()/float()/.item()
+                         coercions inside compile-cache-dispatched kernels.
+
+Finding format: ``file:line: [PASS/CODE] message``.
+
+Suppression grammar: a ``# graft: disable=CODE[,CODE...]`` comment on the
+finding's line (or standalone on the line directly above) suppresses those
+codes there; free-form justification may follow the code list.  Baseline:
+findings whose (file, code, message) fingerprint is grandfathered in the
+JSON baseline (``--write-baseline`` emits one) are reported separately and
+do not fail the run — NEW findings with the same fingerprint beyond the
+recorded count still do.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer result, renderable as ``file:line: [PASS/CODE] message``."""
+
+    path: str
+    line: int
+    pass_name: str
+    code: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] {self.message}"
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        """Line-insensitive identity used by the baseline (edits above a
+        grandfathered finding must not un-grandfather it)."""
+        return (self.path.replace(os.sep, "/"), self.code, self.message)
+
+
+_DISABLE_RE = re.compile(r"#\s*graft:\s*disable=([A-Za-z0-9_*]+(?:\s*,\s*[A-Za-z0-9_*]+)*)")
+
+
+class SourceFile:
+    """A parsed module plus its comment-derived annotation maps.
+
+    Passes receive one of these; everything comment-based (suppressions,
+    ``# guarded-by:``, ``# single-thread:``, ``# arena-live-until:``) is
+    pre-extracted with ``tokenize`` so string literals containing marker
+    text cannot confuse a pass.
+    """
+
+    def __init__(self, text: str, path: str, display_path: Optional[str] = None):
+        self.text = text
+        self.path = path
+        self.display_path = display_path if display_path is not None else path
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        #: lineno -> comment text (with leading '#'), one comment per line max
+        self.comments: Dict[int, str] = {}
+        #: lineno -> set of codes disabled on that line ('*' disables all)
+        self.suppressions: Dict[int, Set[str]] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+                    m = _DISABLE_RE.search(tok.string)
+                    if m:
+                        codes = {c.strip() for c in m.group(1).split(",")}
+                        self.suppressions.setdefault(tok.start[0], set()).update(codes)
+        except (tokenize.TokenError, IndentationError, SyntaxError) as e:
+            self.parse_error = f"tokenize failed: {e}"
+        try:
+            self.tree = ast.parse(text, filename=self.display_path)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg}"
+
+    def comment(self, lineno: int) -> str:
+        return self.comments.get(lineno, "")
+
+    def comment_has(self, lineno: int, marker: str) -> bool:
+        return marker in self.comments.get(lineno, "")
+
+    def span_has(self, start: int, end: int, marker: str) -> bool:
+        """True if any comment on lines ``start..end`` (inclusive) carries
+        ``marker`` — multi-line constructs may hang their marker on any of
+        their physical lines (e.g. the closing paren line)."""
+        return any(
+            marker in self.comments.get(i, "") for i in range(start, end + 1)
+        )
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        """Suppression applies on the finding's own line or as a standalone
+        comment on the line directly above it."""
+        for at in (lineno, lineno - 1):
+            codes = self.suppressions.get(at)
+            if codes and (code in codes or "*" in codes):
+                if at == lineno - 1 and self.lines[at - 1].split("#")[0].strip():
+                    continue  # the line above holds code: its trailing
+                    # comment governs that line, not this one
+                return True
+        return False
+
+    def finding(self, lineno: int, pass_name: str, code: str, message: str) -> Finding:
+        return Finding(self.display_path, lineno, pass_name, code, message)
+
+
+class Pass:
+    """Base class: subclasses set ``name``/``codes`` and implement ``run``."""
+
+    #: short pass name used in the finding format and ``--select``
+    name: str = ""
+    #: finding codes this pass can emit (for --list-passes and docs)
+    codes: Tuple[str, ...] = ()
+    #: one-line description for --list-passes
+    description: str = ""
+
+    def run(self, sf: SourceFile) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Pass] = {}
+
+
+def register(p: Pass) -> Pass:
+    """Add a pass to the registry (module import time); returns it so the
+    call can double as a decorator on an instance-producing class."""
+    if not p.name:
+        raise ValueError("pass must set a name")
+    _REGISTRY[p.name] = p
+    return p
+
+
+def load_passes() -> Dict[str, Pass]:
+    """Import the built-in pass modules (idempotent) and return the registry
+    in registration (= pass number) order."""
+    # imported one by one so registry order == pass number order
+    from gelly_streaming_tpu.analysis import hot_loop  # noqa: F401
+    from gelly_streaming_tpu.analysis import jit_discipline  # noqa: F401
+    from gelly_streaming_tpu.analysis import donation  # noqa: F401
+    from gelly_streaming_tpu.analysis import locks  # noqa: F401
+    from gelly_streaming_tpu.analysis import trace_safety  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+def analyze_source(
+    text: str,
+    filename: str = "<string>",
+    passes: Optional[Sequence[Pass]] = None,
+    path: Optional[str] = None,
+) -> List[Finding]:
+    """Run passes over one module's source; suppressed findings are dropped
+    here so no caller ever sees them."""
+    if passes is None:
+        passes = list(load_passes().values())
+    sf = SourceFile(text, path if path is not None else filename, filename)
+    if sf.parse_error is not None:
+        return [sf.finding(1, "analysis", "PARSE", sf.parse_error)]
+    out: List[Finding] = []
+    for p in passes:
+        for f in p.run(sf):
+            if not sf.suppressed(f.line, f.code):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def analyze_file(
+    path: str,
+    passes: Optional[Sequence[Pass]] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    display = path
+    if root is not None:
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+        if not rel.startswith(".."):
+            display = rel
+    with open(path) as f:
+        return analyze_source(f.read(), display, passes, path=path)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        else:
+            yield path
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    passes: Optional[Sequence[Pass]] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    if passes is None:
+        passes = list(load_passes().values())
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(analyze_file(path, passes, root=root))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline: grandfathered findings live in a JSON file keyed by fingerprint
+# (file, code, message) with a count — line numbers deliberately excluded so
+# unrelated edits above a grandfathered site do not resurrect it, while a
+# SECOND identical finding in the same file still fails the run.
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for item in data.get("findings", []):
+        key = (item["path"], item["code"], item["message"])
+        out[key] = out.get(key, 0) + int(item.get("count", 1))
+    return out
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    data = {
+        "comment": "graftcheck grandfathered findings — regenerate with "
+        "python -m gelly_streaming_tpu.analysis --write-baseline",
+        "findings": [
+            {"path": p, "code": c, "message": m, "count": n}
+            for (p, c, m), n in sorted(counts.items())
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding],
+    baseline: Dict[Tuple[str, str, str], int],
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, grandfathered): up to the baselined count
+    per fingerprint is grandfathered, anything beyond it is new."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        key = f.fingerprint()
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def package_root() -> str:
+    """The installed ``gelly_streaming_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline_path() -> str:
+    """The baseline ships inside the package so the tier-1 gate and the
+    bench find it regardless of the working directory."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")

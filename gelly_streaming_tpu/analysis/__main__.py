@@ -1,0 +1,136 @@
+"""graftcheck driver: ``python -m gelly_streaming_tpu.analysis`` /
+``gelly-analyze``.
+
+Exit codes: 0 = clean (no unsuppressed, non-grandfathered findings),
+1 = findings, 2 = usage error.  Pure-AST: importing this never imports
+jax, so the analyzer runs anywhere (CI, the bench watchdog) in ~100 ms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from gelly_streaming_tpu import analysis
+
+
+def _resolve_paths(paths: List[str]) -> List[str]:
+    """Bare package-dir names (``core``, ``io``, ...) resolve against the
+    installed package when they don't exist relative to the cwd, so the
+    canonical invocation works from any directory."""
+    root = analysis.package_root()
+    out = []
+    for p in paths:
+        if os.path.exists(p):
+            out.append(p)
+            continue
+        candidate = os.path.join(root, p)
+        if os.path.exists(candidate):
+            out.append(candidate)
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gelly-analyze",
+        description="graftcheck: static-analysis pass suite for the "
+        "streaming runtime's concurrency, donation, compile-cache, and "
+        "trace-safety invariants",
+    )
+    parser.add_argument(
+        "--paths",
+        nargs="+",
+        default=["core", "io", "library", "parallel"],
+        help="files/directories to scan; bare names resolve inside the "
+        "gelly_streaming_tpu package (default: core io library parallel)",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated pass names to run (default: all)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=analysis.default_baseline_path(),
+        help="JSON baseline of grandfathered findings "
+        "(default: the package's shipped baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report grandfathered findings as failures too",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings to --baseline and exit 0",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress the summary line"
+    )
+    args = parser.parse_args(argv)
+
+    passes = analysis.load_passes()
+    if args.list_passes:
+        for i, p in enumerate(passes.values()):
+            codes = ",".join(p.codes)
+            print(f"#{i} {p.name} [{codes}] — {p.description}")
+        return 0
+
+    selected = list(passes.values())
+    if args.select:
+        names = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [n for n in names if n not in passes]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        selected = [passes[n] for n in names]
+
+    try:
+        paths = _resolve_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"no such path: {e}", file=sys.stderr)
+        return 2
+
+    root = os.path.dirname(analysis.package_root())
+    findings = analysis.analyze_paths(paths, selected, root=root)
+
+    if args.write_baseline:
+        analysis.write_baseline(findings, args.baseline)
+        if not args.quiet:
+            print(
+                f"wrote {len(findings)} grandfathered finding(s) to "
+                f"{args.baseline}"
+            )
+        return 0
+
+    grandfathered: List[analysis.Finding] = []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = analysis.load_baseline(args.baseline)
+        findings, grandfathered = analysis.apply_baseline(findings, baseline)
+
+    for f in findings:
+        print(f.format())
+    if not args.quiet:
+        n_files = len(set(f.path for f in findings))
+        summary = (
+            f"graftcheck: {len(findings)} finding(s)"
+            + (f" in {n_files} file(s)" if findings else "")
+            + (
+                f" ({len(grandfathered)} grandfathered by baseline)"
+                if grandfathered
+                else ""
+            )
+        )
+        print(summary, file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,272 @@
+"""Pass #2 — ``donation-safety``: no touching buffers after donating them.
+
+Two ownership hand-offs in the runtime invalidate a live Python name:
+
+* an argument passed at a ``donate_argnums`` position of a cached
+  executable (``compile_cache.cached_jit(..., donate_argnums=...)`` or a
+  raw ``jax.jit(..., donate_argnums=...)``) — XLA may reuse the buffer for
+  the output, so a later read observes garbage (or a deleted-array error);
+* an arena checked out of ``ArenaPool.acquire`` once it has been handed to
+  the device (``device_put`` or any donating executable) — on the CPU
+  backend the transfer may alias the host memory zero-copy, so the pack
+  thread scribbling on it races the in-flight fold.
+
+The pass tracks, per function and in source order, names bound from
+``<pool>.acquire(...)`` (pool = any name assigned from ``ArenaPool(...)``)
+and names passed at donated positions; a read or re-dispatch of a dead name
+is a DONATE finding until either the name is rebound or the sanctioned
+drain point is reached — the line carrying the ``# arena-live-until:
+drain`` marker (the completion-queue drain that proves the consuming fold
+finished; ``release``/``wait_ready`` calls are the drain machinery and are
+exempt).
+
+Limits (deliberate, documented): straight-line per-function analysis in
+line order — loop-carried reuse and attribute-held executables are not
+tracked (the async pipeline holds its executables on ``self``; the pass
+exists to catch the local-name pattern the fixtures seed, which is also the
+shape every hot path in-tree uses).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gelly_streaming_tpu import analysis
+
+_DRAIN_MARKER = "arena-live-until: drain"
+_DRAIN_CALL_NAMES = {"release", "wait_ready"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The constant donate_argnums of a jit/cached_jit call, if present."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out)
+    return None
+
+
+def _is_jit_like(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in ("jit", "cached_jit"):
+        return True
+    if isinstance(fn, ast.Name) and fn.id in ("jit", "cached_jit"):
+        return True
+    return False
+
+
+def _is_device_put(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "device_put") or (
+        isinstance(fn, ast.Name) and fn.id == "device_put"
+    )
+
+
+def _is_arena_pool_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    return name.endswith("ArenaPool")
+
+
+class DonationSafetyPass(analysis.Pass):
+    name = "donation-safety"
+    codes = ("DONATE",)
+    description = "no reads of donated buffers / handed-off arenas"
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        # ---- module-wide fact gathering ---------------------------------
+        pool_names: Set[str] = set()
+        donating_fns: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    if _is_arena_pool_ctor(node.value):
+                        pool_names.add(t.id)
+                    elif isinstance(node.value, ast.Call) and _is_jit_like(
+                        node.value
+                    ):
+                        pos = _donated_positions(node.value)
+                        if pos:
+                            donating_fns[t.id] = pos
+        if not pool_names and not donating_fns:
+            return []
+
+        findings: List[analysis.Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(
+                    sf, node, pool_names, donating_fns, findings
+                )
+        return findings
+
+    # ---- per-function linear simulation ---------------------------------
+
+    def _check_function(self, sf, func, pool_names, donating_fns, findings):
+        #: name -> ("donated arg"|"handed-off arena", hand-off line)
+        dead: Dict[str, Tuple[str, int]] = {}
+        arenas: Set[str] = set()
+
+        func_end = getattr(func, "end_lineno", None) or func.lineno
+        drain_lines = {
+            ln
+            for ln in sf.comments
+            if func.lineno <= ln <= func_end
+            and sf.comment_has(ln, _DRAIN_MARKER)
+        }
+
+        def events(node):
+            """(order-key, kind, payload) events for this function body in
+            EVALUATION order, not descending into nested defs: argument
+            loads sort at their own position, a call's donation effect at
+            its closing paren, and an assignment's target store after its
+            value expression — so ``state = fold(state, buf)`` reads, then
+            donates, then rebinds (the ubiquitous donated-carry pattern)."""
+            out = []
+            #: Store-name position -> sort key pushed past the RHS
+            store_keys: Dict[Tuple[int, int], Tuple[int, int]] = {}
+            for n in ast.walk(func):
+                if isinstance(n, ast.Assign):
+                    after_value = (
+                        getattr(n.value, "end_lineno", n.lineno),
+                        getattr(n.value, "end_col_offset", 0) + 1,
+                    )
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            store_keys[(t.lineno, t.col_offset)] = after_value
+
+            def walk(n):
+                for child in ast.iter_child_nodes(n):
+                    if isinstance(
+                        child,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ):
+                        continue  # separate scope, analyzed on its own
+                    walk_node(child)
+                    walk(child)
+
+            def walk_node(n):
+                key = (getattr(n, "lineno", 0), getattr(n, "col_offset", 0))
+                if isinstance(n, ast.Name):
+                    if isinstance(n.ctx, ast.Load):
+                        out.append((key, "load", n))
+                    elif isinstance(n.ctx, ast.Store):
+                        out.append((store_keys.get(key, key), "store", n))
+                elif isinstance(n, ast.Call):
+                    end = (
+                        getattr(n, "end_lineno", n.lineno),
+                        getattr(n, "end_col_offset", 0),
+                    )
+                    out.append((end, "call", n))
+                elif isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name
+                ):
+                    out.append((key, "load", n.target))  # x += 1 reads x
+
+            for stmt in func.body:
+                walk_node(stmt)
+                walk(stmt)
+            out.sort(key=lambda e: e[0])
+            return out
+
+        # names currently inside the argument list of an exempt drain call
+        def _in_drain_call(call: ast.Call) -> bool:
+            fn = call.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            return name in _DRAIN_CALL_NAMES
+
+        exempt_spans: List[Tuple[int, int]] = []
+        assigns: Dict[Tuple[int, int], ast.AST] = {}
+        for n in ast.walk(func):
+            if isinstance(n, ast.Call) and _in_drain_call(n):
+                end = getattr(n, "end_lineno", None) or n.lineno
+                exempt_spans.append((n.lineno, end))
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigns[(t.lineno, t.col_offset)] = n.value
+
+        def exempt(lineno: int) -> bool:
+            if any(s <= lineno <= e for s, e in exempt_spans):
+                return True
+            return any(d <= lineno for d in drain_lines)
+
+        for (lineno, _col), kind, node in events(func):
+            past_drain = any(d <= lineno for d in drain_lines)
+            if kind == "store":
+                dead.pop(node.id, None)
+                arenas.discard(node.id)
+                value = assigns.get((node.lineno, node.col_offset))
+                if (
+                    value is not None
+                    and isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr == "acquire"
+                    and isinstance(value.func.value, ast.Name)
+                    and value.func.value.id in pool_names
+                ):
+                    arenas.add(node.id)
+            elif kind == "load":
+                info = dead.get(node.id)
+                if info is not None and not exempt(node.lineno):
+                    why, at = info
+                    findings.append(
+                        sf.finding(
+                            node.lineno,
+                            self.name,
+                            "DONATE",
+                            f"'{node.id}' was {why} on line {at} and must "
+                            "not be touched again before the completion-"
+                            "queue drain (rebind it, or move the access "
+                            "past the '# arena-live-until: drain' point)",
+                        )
+                    )
+            elif kind == "call":
+                if past_drain or _in_drain_call(node):
+                    continue
+                fn = node.func
+                callee = (
+                    fn.id
+                    if isinstance(fn, ast.Name)
+                    else fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else ""
+                )
+                donated = donating_fns.get(callee)
+                if donated is not None:
+                    for pos in donated:
+                        if pos < len(node.args) and isinstance(
+                            node.args[pos], ast.Name
+                        ):
+                            dead[node.args[pos].id] = (
+                                "donated (donate_argnums)",
+                                node.lineno,
+                            )
+                if donated is not None or _is_device_put(node):
+                    for arg in ast.walk(node):
+                        if (
+                            isinstance(arg, ast.Name)
+                            and isinstance(arg.ctx, ast.Load)
+                            and arg.id in arenas
+                        ):
+                            dead[arg.id] = (
+                                "handed to the device (ArenaPool arena)",
+                                node.lineno,
+                            )
+
+
+analysis.register(DonationSafetyPass())

@@ -1,0 +1,177 @@
+"""Pass #0 — ``hot-loop``: no blocking host syncs inside ``# hot-loop``
+regions (migrated from utils/hot_loop_lint.py, whose public API now
+re-exports from here).
+
+The async window pipeline's whole premise (core/async_exec.py) is that the
+dispatch loops never wait on the device: a single ``np.asarray`` /
+``.item()`` / ``block_until_ready`` re-introduced into a dispatch loop
+silently turns the overlapped pipeline back into the one-RTT-per-window
+lockstep.
+
+Markers (plain comments, so the regions are self-documenting in context):
+
+* ``# hot-loop`` — a standalone comment line opening a region (trailing
+  text after the marker is free-form description).
+* ``# hot-loop-end`` — closes the innermost open region.
+* ``# hot-loop-ok`` — trailing comment allowlisting ONE call inside a
+  region (the completion-queue drain is the sanctioned sync point).  The
+  marker is honored on ANY physical line of the call — a multi-line call
+  may hang it on its closing-paren line.
+
+Inside a region, calls to ``np.asarray``/``numpy.asarray`` (or a bare
+``asarray``), any ``.item()`` method, and ``block_until_ready`` (method or
+``jax.block_until_ready``) are violations.  ``jnp.asarray`` is NOT flagged:
+a host->device transfer is pipeline work, not a sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Tuple
+
+from gelly_streaming_tpu import analysis
+
+#: call shapes that block the caller on device results
+_FORBIDDEN_ATTRS = {"item", "block_until_ready"}
+_FORBIDDEN_NP_FUNCS = {"asarray"}
+_NP_NAMES = {"np", "numpy", "onp"}
+_FORBIDDEN_BARE = {"asarray", "block_until_ready"}
+
+
+def _regions(lines: List[str]) -> Tuple[List[Tuple[int, int]], List[str]]:
+    """(closed (start, end) 1-based line ranges, marker errors)."""
+    open_stack: List[int] = []
+    closed: List[Tuple[int, int]] = []
+    errors: List[str] = []
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if stripped.startswith("#") and "hot-loop" in stripped:
+            body = stripped.lstrip("#").strip()
+            if body.startswith("hot-loop-end"):
+                if not open_stack:
+                    errors.append(f"line {i}: hot-loop-end without hot-loop")
+                else:
+                    closed.append((open_stack.pop(), i))
+            elif body.startswith("hot-loop-ok"):
+                pass  # allowlist marker on its own line: no region effect
+            elif body.startswith("hot-loop"):
+                open_stack.append(i)
+    for start in open_stack:
+        errors.append(f"line {start}: hot-loop region never closed")
+    return closed, errors
+
+
+def _violation(node: ast.Call) -> "str | None":
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _FORBIDDEN_ATTRS:
+            return f"{fn.attr}()"
+        if (
+            fn.attr in _FORBIDDEN_NP_FUNCS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _NP_NAMES
+        ):
+            return f"{fn.value.id}.{fn.attr}()"
+    elif isinstance(fn, ast.Name) and fn.id in _FORBIDDEN_BARE:
+        return f"{fn.id}()"
+    return None
+
+
+def _raw_findings(source: str, filename: str) -> List[Tuple[int, str, str]]:
+    """(line, code, message) triples — shared by the legacy string API and
+    the framework pass so the two can never drift."""
+    lines = source.splitlines()
+    regions, errors = _regions(lines)
+    problems: List[Tuple[int, str, str]] = []
+    for e in errors:
+        # legacy message shape is "line N: ...": reuse its line number
+        lineno = int(e.split(":", 1)[0].split()[-1])
+        problems.append((lineno, "HOTMARK", e))
+    if not regions:
+        return problems
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError:
+        return problems  # the framework reports parse errors itself
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        lineno = node.lineno
+        if not any(start < lineno < end for start, end in regions):
+            continue
+        what = _violation(node)
+        if what is None:
+            continue
+        # the allowlist marker may sit on ANY physical line of the call —
+        # a call spanning lines commonly carries it on the closing paren
+        # line (hot_loop_lint's original single-line scan missed those)
+        end = getattr(node, "end_lineno", None) or lineno
+        span = lines[lineno - 1 : min(end, len(lines))]
+        if any("# hot-loop-ok" in line_src for line_src in span):
+            continue
+        problems.append(
+            (
+                lineno,
+                "HOTSYNC",
+                f"blocking host sync {what} inside a # hot-loop region "
+                "(move it to the completion-queue drain, or allowlist the "
+                "line with '# hot-loop-ok' and justify it)",
+            )
+        )
+    problems.sort()
+    return problems
+
+
+# -- legacy string API (utils/hot_loop_lint.py re-exports these) ------------
+
+
+def check_source(source: str, filename: str = "<string>") -> List[str]:
+    """Lint one module's source; returns ``file:line: message`` strings."""
+    out = []
+    for lineno, code, message in _raw_findings(source, filename):
+        if code == "HOTMARK":
+            out.append(f"{filename}:{message}")
+        else:
+            out.append(f"{filename}:{lineno}: {message}")
+    return out
+
+
+def check_file(path: str) -> List[str]:
+    with open(path) as f:
+        return check_source(f.read(), filename=path)
+
+
+def check_paths(paths) -> List[str]:
+    """Lint every ``.py`` file under the given files/directories."""
+    problems: List[str] = []
+    for path in analysis.iter_python_files(paths):
+        problems.extend(check_file(path))
+    return problems
+
+
+def package_hot_loop_paths() -> List[str]:
+    """The directories whose hot-loop regions tier-1 pins: the core
+    runtime and the io planes (plus library/, which hosts the windowed
+    triangle loops)."""
+    root = analysis.package_root()
+    return [
+        os.path.join(root, "core"),
+        os.path.join(root, "io"),
+        os.path.join(root, "library"),
+    ]
+
+
+class HotLoopPass(analysis.Pass):
+    name = "hot-loop"
+    codes = ("HOTSYNC", "HOTMARK")
+    description = "no blocking host syncs inside # hot-loop regions"
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        return [
+            sf.finding(lineno, self.name, code, message)
+            for lineno, code, message in _raw_findings(sf.text, sf.display_path)
+        ]
+
+
+analysis.register(HotLoopPass())

@@ -1,0 +1,67 @@
+"""Pass #1 — ``jit-discipline``: raw ``jax.jit`` bypasses the executable
+cache.
+
+PR 1's retrace guard only works because every hot dispatch plane routes its
+``jax.jit`` through ``core/compile_cache.cached_jit``: the cache meters
+compiles, shares executables process-wide, and keeps ``recompiles()`` at
+zero across re-created streams/descriptors/windows.  A raw ``jax.jit`` call
+site re-opens the hole — a fresh closure per instance recompiles the same
+kernel invisibly (seconds per compile on a TPU) and the bench's
+zero-recompile attestation cannot see it.
+
+Flagged: every ``jax.jit`` attribute reference (call, decorator, or
+``partial(jax.jit, ...)`` operand) and direct ``from jax import jit``
+imports, in any scanned file except ``compile_cache.py`` itself (the one
+sanctioned wrapper).  Cold paths with a deliberate raw jit carry a
+``# graft: disable=RAWJIT`` suppression with justification, or live in the
+baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from gelly_streaming_tpu import analysis
+
+_MESSAGE = (
+    "raw jax.jit bypasses core/compile_cache.cached_jit — recompiles are "
+    "invisible to the retrace guard and executables are not shared "
+    "process-wide (route through cached_jit, or suppress with a "
+    "justification)"
+)
+
+
+class JitDisciplinePass(analysis.Pass):
+    name = "jit-discipline"
+    codes = ("RAWJIT",)
+    description = "jax.jit only via core/compile_cache.cached_jit"
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        if os.path.basename(sf.path) == "compile_cache.py":
+            return []  # the sanctioned wrapper
+        out: List[analysis.Finding] = []
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"
+            ):
+                out.append(sf.finding(node.lineno, self.name, "RAWJIT", _MESSAGE))
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                if any(alias.name == "jit" for alias in node.names):
+                    out.append(
+                        sf.finding(
+                            node.lineno,
+                            self.name,
+                            "RAWJIT",
+                            "importing jit from jax invites raw call sites — "
+                            + _MESSAGE,
+                        )
+                    )
+        return out
+
+
+analysis.register(JitDisciplinePass())

@@ -1,0 +1,214 @@
+"""Pass #3 — ``lock-discipline``: annotated shared state only under its lock.
+
+The pipeline mutates shared state from three threads (pack, transfer,
+drain) behind ad-hoc locks; an interleaving-dependent test suite cannot
+reliably reproduce the lost-update it takes one missed ``with`` to cause.
+This pass pins the discipline statically: an attribute or module global
+annotated ``# guarded-by: <lockname>`` on its declaration line may only be
+read or written inside a ``with self.<lockname>:`` / ``with <lockname>:``
+block — or inside a function marked single-threaded.
+
+Annotation grammar:
+
+* ``# guarded-by: <lockname>`` — trailing comment on the declaration
+  (``self.attr = ...`` in a method, or a module-level ``NAME = ...``).
+  The lock is ``self.<lockname>`` for instance attributes and the module
+  global ``<lockname>`` for globals.
+* ``# single-thread: <stage>`` — on a ``def`` line (or the line above the
+  ``def`` / its decorators): the whole function runs on one thread and is
+  exempt.  On an access line: that line alone is exempt.
+
+Scope and limits (deliberate): instance attributes are checked inside their
+defining class only (``self.X``); aliasing through other names is not
+tracked, and a lock held by a CALLER does not exempt a callee — factor the
+locked section so the ``with`` is visible where the access is, which is
+also what makes the code reviewable.  Module top-level statements run on
+the importing thread and are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from gelly_streaming_tpu import analysis
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_SINGLE_RE = re.compile(r"#\s*single-thread:")
+
+
+def _guard_on_lines(sf: analysis.SourceFile, start: int, end: int) -> Optional[str]:
+    for i in range(start, end + 1):
+        m = _GUARDED_RE.search(sf.comment(i))
+        if m:
+            return m.group(1)
+    return None
+
+
+def _single_thread_marked(sf: analysis.SourceFile, node: ast.AST) -> bool:
+    """``# single-thread:`` on the def line, its decorators, or the line
+    directly above the construct."""
+    first = min(
+        [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
+    )
+    for i in range(first - 1, node.body[0].lineno):
+        if _SINGLE_RE.search(sf.comment(i)):
+            return True
+    return False
+
+
+class LockDisciplinePass(analysis.Pass):
+    name = "lock-discipline"
+    codes = ("UNGUARDED",)
+    description = "# guarded-by: state accessed only under its lock"
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        # ---- collect annotated declarations -----------------------------
+        #: (class name, attr) -> lock attr name (lock reached via self)
+        attr_guards: Dict[Tuple[str, str], str] = {}
+        #: global name -> lock global name
+        global_guards: Dict[str, str] = {}
+        #: lines of the declarations themselves (exempt from checking)
+        decl_lines: Set[int] = set()
+
+        def collect(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    collect(child, child.name)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    end = getattr(child, "end_lineno", None) or child.lineno
+                    lock = _guard_on_lines(sf, child.lineno, end)
+                    if lock is not None:
+                        targets = (
+                            child.targets
+                            if isinstance(child, ast.Assign)
+                            else [child.target]
+                        )
+                        for t in targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                                and cls is not None
+                            ):
+                                attr_guards[(cls, t.attr)] = lock
+                                decl_lines.update(range(child.lineno, end + 1))
+                            elif isinstance(t, ast.Name) and cls is None:
+                                global_guards[t.id] = lock
+                                decl_lines.update(range(child.lineno, end + 1))
+                collect(child, cls)
+
+        collect(sf.tree, None)
+        if not attr_guards and not global_guards:
+            return []
+
+        findings: List[analysis.Finding] = []
+
+        def line_exempt(lineno: int) -> bool:
+            return lineno in decl_lines or bool(_SINGLE_RE.search(sf.comment(lineno)))
+
+        def check(
+            node: ast.AST,
+            cls: Optional[str],
+            func_depth: int,
+            locks: Set[Tuple[str, str]],
+            single: bool,
+        ) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    check(child, child.name, func_depth, set(), single)
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # a nested function may run on any thread at any time:
+                    # it inherits neither the enclosing with-blocks nor, for
+                    # safety, an enclosing function's single-thread marking
+                    check(
+                        child,
+                        cls,
+                        func_depth + 1,
+                        set(),
+                        _single_thread_marked(sf, child),
+                    )
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    held = set(locks)
+                    for item in child.items:
+                        ctx = item.context_expr
+                        if (
+                            isinstance(ctx, ast.Attribute)
+                            and isinstance(ctx.value, ast.Name)
+                            and ctx.value.id == "self"
+                        ):
+                            held.add(("self", ctx.attr))
+                        elif isinstance(ctx, ast.Name):
+                            held.add(("global", ctx.id))
+                    for stmt in child.body:
+                        check(stmt, cls, func_depth, held, single)
+                        _inspect(stmt, cls, func_depth, held, single)
+                    continue
+                _inspect(child, cls, func_depth, locks, single)
+                check(child, cls, func_depth, locks, single)
+
+        def _inspect(
+            node: ast.AST,
+            cls: Optional[str],
+            func_depth: int,
+            locks: Set[Tuple[str, str]],
+            single: bool,
+        ) -> None:
+            if func_depth == 0 or single:
+                return  # module import / marked single-thread: exempt
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and cls is not None
+                and (cls, node.attr) in attr_guards
+            ):
+                lock = attr_guards[(cls, node.attr)]
+                if ("self", lock) not in locks and not line_exempt(node.lineno):
+                    findings.append(
+                        sf.finding(
+                            node.lineno,
+                            self.name,
+                            "UNGUARDED",
+                            f"self.{node.attr} is '# guarded-by: {lock}' but "
+                            f"accessed outside 'with self.{lock}:' (take the "
+                            "lock, or mark the function '# single-thread: "
+                            "<stage>' with a justification)",
+                        )
+                    )
+            elif (
+                isinstance(node, ast.Name)
+                and node.id in global_guards
+                and isinstance(node.ctx, (ast.Load, ast.Store, ast.Del))
+            ):
+                lock = global_guards[node.id]
+                if ("global", lock) not in locks and not line_exempt(node.lineno):
+                    findings.append(
+                        sf.finding(
+                            node.lineno,
+                            self.name,
+                            "UNGUARDED",
+                            f"{node.id} is '# guarded-by: {lock}' but accessed "
+                            f"outside 'with {lock}:' (take the lock, or mark "
+                            "the function '# single-thread: <stage>' with a "
+                            "justification)",
+                        )
+                    )
+
+        check(sf.tree, None, 0, set(), False)
+        # one finding per (line, message): an attribute read+written on one
+        # line (augassign) would otherwise double-report
+        seen: Set[Tuple[int, str]] = set()
+        out = []
+        for f in findings:
+            if (f.line, f.message) not in seen:
+                seen.add((f.line, f.message))
+                out.append(f)
+        return out
+
+
+analysis.register(LockDisciplinePass())

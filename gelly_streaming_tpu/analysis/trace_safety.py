@@ -1,0 +1,332 @@
+"""Pass #4 — ``trace-safety``: no Python control flow on traced values.
+
+Inside a function that XLA traces (dispatched through the compile cache, or
+jitted directly), a Python ``if``/``while`` on a traced parameter forces
+concretization — at best a ``ConcretizationTypeError``, at worst (when the
+value happens to be concrete at trace time, e.g. a weakly-typed constant) a
+silent per-value retrace that the compile-cache retrace guard then reports
+long after the cause.  The same goes for ``int()``/``bool()``/``float()``
+and ``.item()`` coercions of tracers: each is a host sync AND a
+concretization point.
+
+Traced functions are recognized syntactically, per module:
+
+* a ``def`` decorated ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
+* a ``def`` (or lambda body name) passed to ``jax.jit(f)`` or as the build
+  of ``compile_cache.cached_jit(key, lambda: f)`` / ``cached_jit(key, f)``;
+* any ``def`` nested inside a build function handed to ``cached_jit`` by
+  name (the kernels a build closure returns), or inside another traced
+  function;
+* any ``def`` wrapped in ``shard_map(f, ...)`` (always jitted downstream).
+
+Static parameters (``static_argnums`` / ``static_argnames`` on the jit or
+cached_jit site, positional mapping for decorators) are concrete by
+contract and exempt.  A test that only touches a parameter's structure is
+also exempt: ``x is None`` / ``is not None`` checks, ``x.shape`` /
+``x.ndim`` / ``x.dtype`` / ``x.size`` attributes, and ``len(x)`` are all
+trace-time constants.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gelly_streaming_tpu import analysis
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_CAST_NAMES = {"int", "bool", "float"}
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The decorator as a pseudo jit call (for static kwargs), if it is a
+    jit decorator at all; bare ``@jax.jit`` returns a constant-free Call."""
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Name) and dec.id == "jit":
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "jit") or (
+            isinstance(fn, ast.Name) and fn.id == "jit"
+        ):
+            return dec
+        if (isinstance(fn, ast.Name) and fn.id == "partial") or (
+            isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        ):
+            if dec.args and _is_jit_expr(dec.args[0]):
+                return dec
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "jit"
+    ) or (isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _static_spec(call: Optional[ast.Call]) -> Tuple[Set[int], Set[str]]:
+    """Constant static_argnums / static_argnames from a jit-like call."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    if call is None:
+        return nums, names
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnums":
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        nums.add(elt.value)
+        elif kw.arg == "static_argnames":
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        names.add(elt.value)
+    return nums, names
+
+
+def _traced_params(
+    func: ast.AST, static_nums: Set[int], static_names: Set[str]
+) -> Set[str]:
+    args = func.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    traced = set()
+    for i, name in enumerate(params):
+        if i in static_nums or name in static_names:
+            continue
+        if name == "self":
+            continue
+        traced.add(name)
+    traced.update(
+        a.arg for a in args.kwonlyargs if a.arg not in static_names
+    )
+    return traced
+
+
+def _is_cached_jit(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "cached_jit") or (
+        isinstance(fn, ast.Name) and fn.id == "cached_jit"
+    )
+
+
+def _is_shard_map(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "shard_map") or (
+        isinstance(fn, ast.Name) and fn.id == "shard_map"
+    )
+
+
+class TraceSafetyPass(analysis.Pass):
+    name = "trace-safety"
+    codes = ("TRACEIF", "TRACECAST")
+    description = "no Python branches/casts on traced values in kernels"
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        #: function node -> (static_argnums, static_argnames)
+        traced: Dict[ast.AST, Tuple[Set[int], Set[str]]] = {}
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        builders: List[Tuple[ast.AST, ast.Call]] = []
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    call = _jit_decorator(dec)
+                    if call is not None:
+                        traced[node] = _static_spec(call)
+
+        def mark_by_name(name: str, spec) -> None:
+            for fn in defs_by_name.get(name, []):
+                traced.setdefault(fn, spec)
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_expr(node.func) and node.args:
+                spec = _static_spec(node)
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    mark_by_name(target.id, spec)
+                elif isinstance(target, ast.Call) and _is_shard_map(target):
+                    if target.args and isinstance(target.args[0], ast.Name):
+                        mark_by_name(target.args[0].id, spec)
+            elif _is_shard_map(node):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    mark_by_name(node.args[0].id, (set(), set()))
+            elif _is_cached_jit(node) and len(node.args) >= 2:
+                spec = _static_spec(node)
+                build = node.args[1]
+                if isinstance(build, ast.Lambda) and isinstance(
+                    build.body, ast.Name
+                ):
+                    mark_by_name(build.body.id, spec)
+                elif isinstance(build, ast.Name):
+                    # a named build: the kernels are the defs nested inside
+                    # it — the build body itself runs at build time
+                    for b in defs_by_name.get(build.id, []):
+                        builders.append((b, node))
+
+        for builder, call in builders:
+            spec = _static_spec(call)
+            for inner in ast.walk(builder):
+                if inner is not builder and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    traced.setdefault(inner, spec)
+
+        # defs nested inside a traced function are traced too (no statics
+        # of their own — their params are whatever the parent passes)
+        frontier = list(traced)
+        while frontier:
+            parent = frontier.pop()
+            for inner in ast.walk(parent):
+                if inner is not parent and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    if inner not in traced:
+                        traced[inner] = (set(), set())
+                        frontier.append(inner)
+
+        findings: List[analysis.Finding] = []
+        for fn, (nums, names) in traced.items():
+            params = _traced_params(fn, nums, names)
+            if params:
+                self._check_body(sf, fn, params, findings)
+        findings.sort(key=lambda f: (f.line, f.code))
+        # nested traced defs are reachable from several roots: dedup
+        seen = set()
+        out = []
+        for f in findings:
+            key = (f.line, f.code, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def _check_body(
+        self,
+        sf: analysis.SourceFile,
+        func: ast.AST,
+        params: Set[str],
+        findings: List[analysis.Finding],
+    ) -> None:
+        def param_loads(node: ast.AST) -> List[ast.Name]:
+            """Loads of traced params in ``node`` that are NOT structural
+            (is-None tests, .shape/.ndim/.dtype/.size, len())."""
+            shadowed = set()
+            for inner in ast.walk(node):
+                if isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    a = inner.args
+                    shadowed.update(
+                        x.arg for x in a.posonlyargs + a.args + a.kwonlyargs
+                    )
+
+            structural: Set[int] = set()
+
+            def scan(n, parent_ok: bool):
+                if isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+                ):
+                    comparands = [n.left] + list(n.comparators)
+                    if any(
+                        isinstance(c, ast.Constant) and c.value is None
+                        for c in comparands
+                    ):
+                        parent_ok = True
+                if isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS:
+                    parent_ok = True
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "len"
+                ):
+                    parent_ok = True
+                if isinstance(n, ast.Name) and parent_ok:
+                    structural.add(id(n))
+                for child in ast.iter_child_nodes(n):
+                    scan(child, parent_ok)
+
+            scan(node, False)
+            return [
+                n
+                for n in ast.walk(node)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in params
+                and n.id not in shadowed
+                and id(n) not in structural
+            ]
+
+        # exclude nested function subtrees: each nested def is traced (and
+        # checked) in its own right, against its OWN parameter list
+        nested: Set[int] = set()
+        for n in ast.walk(func):
+            if n is not func and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                nested.update(id(d) for d in ast.walk(n))
+
+        for node in ast.walk(func):
+            if id(node) in nested:
+                continue
+            if isinstance(node, (ast.If, ast.While)):
+                hits = param_loads(node.test)
+                if hits:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(
+                        sf.finding(
+                            node.lineno,
+                            self.name,
+                            "TRACEIF",
+                            f"Python {kind} on traced parameter "
+                            f"'{hits[0].id}' inside a compiled kernel — use "
+                            "jnp.where/lax.cond (value branches retrace or "
+                            "raise ConcretizationTypeError)",
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Name)
+                    and fn.id in _CAST_NAMES
+                    and node.args
+                    and param_loads(node.args[0])
+                ):
+                    findings.append(
+                        sf.finding(
+                            node.lineno,
+                            self.name,
+                            "TRACECAST",
+                            f"{fn.id}() concretizes traced parameter "
+                            f"'{param_loads(node.args[0])[0].id}' inside a "
+                            "compiled kernel (host sync + retrace hazard — "
+                            "keep it a tracer, or hoist the cast to the "
+                            "caller)",
+                        )
+                    )
+                elif isinstance(fn, ast.Attribute) and fn.attr == "item":
+                    if param_loads(fn.value):
+                        findings.append(
+                            sf.finding(
+                                node.lineno,
+                                self.name,
+                                "TRACECAST",
+                                ".item() concretizes a traced value inside "
+                                "a compiled kernel (host sync + retrace "
+                                "hazard — keep it a tracer, or hoist the "
+                                "read to the caller)",
+                            )
+                        )
+
+
+analysis.register(TraceSafetyPass())

@@ -924,7 +924,7 @@ class SummaryAggregation:
         def release(item):
             (pane, arenas), _dev = item
             if arenas is not None:
-                pool.release(*arenas)
+                pool.release(*arenas)  # arena-live-until: drain
 
         with wire_mod.Prefetcher(
             stream_panes(stream, window_ms), prepare, depth=depth + 1
@@ -1333,7 +1333,7 @@ class MeshAggregationRunner:
 
         spec = P(self._axis)
         val_spec = spec if has_val else None
-        fn = jax.jit(
+        fn = jax.jit(  # graft: disable=RAWJIT — keyed per-mesh in self._step_cache; a Mesh is not a stable process-global cache key
             shard_map(
                 step,
                 mesh=self.mesh,
@@ -1370,7 +1370,7 @@ class MeshAggregationRunner:
             return fold_combine(src, dst, None, mask)
 
         spec = P(self._axis)
-        fn = jax.jit(
+        fn = jax.jit(  # graft: disable=RAWJIT — keyed per-mesh in self._step_cache; a Mesh is not a stable process-global cache key
             shard_map(
                 step,
                 mesh=self.mesh,
@@ -1439,7 +1439,7 @@ class MeshAggregationRunner:
 
         spec = P(self._axis)
         entry = (
-            jax.jit(
+            jax.jit(  # graft: disable=RAWJIT — keyed per-mesh in self._step_cache; a Mesh is not a stable process-global cache key
                 shard_map(
                     step,
                     mesh=self.mesh,
@@ -1448,7 +1448,7 @@ class MeshAggregationRunner:
                 ),
                 donate_argnums=0,
             ),
-            jax.jit(
+            jax.jit(  # graft: disable=RAWJIT — keyed per-mesh in self._step_cache; a Mesh is not a stable process-global cache key
                 shard_map(
                     finish, mesh=self.mesh, in_specs=(spec,), out_specs=P()
                 )

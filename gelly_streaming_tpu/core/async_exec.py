@@ -127,7 +127,9 @@ class ArenaPool:
 
     def __init__(self, per_shape: int = 8):
         self._per_shape = max(1, per_shape)
-        self._free: dict = {}  # (shape, dtype str) -> list of arrays
+        # (shape, dtype str) -> free arrays; touched by the pack thread
+        # (acquire) and the drain (release) concurrently
+        self._free: dict = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def acquire(self, shape, dtype) -> np.ndarray:
@@ -308,7 +310,7 @@ def async_merge_loop(
             # the emission depends on this window's fold: its completion
             # proves the fold consumed the arena's host memory
             wait_ready(rec)
-            release(payload)
+            release(payload)  # arena-live-until: drain — this IS the drain
         return wid, rec, summary
 
     panes_it = iter(panes)

@@ -39,21 +39,25 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 _LOCK = threading.RLock()
-_ENTRIES: "Dict[Any, _CachedFn]" = {}
+# Shared across every dispatch thread (sync loops, the async pipeline's
+# dispatch + drain, the mesh runners): the ``# guarded-by: _LOCK``
+# annotations below are enforced by the lock-discipline analyzer pass
+# (gelly_streaming_tpu/analysis/locks.py).
+_ENTRIES: "Dict[Any, _CachedFn]" = {}  # guarded-by: _LOCK
 _CAPACITY = 128
 
-_KEY_HITS = 0
-_KEY_MISSES = 0
+_KEY_HITS = 0  # guarded-by: _LOCK
+_KEY_MISSES = 0  # guarded-by: _LOCK
 # (kernel cache key, abstract signature) -> number of XLA compiles observed;
 # >1 for any pair means the SAME kernel+shape was traced more than once (an
 # eviction rebuild or a jit-internal retrace) — distinct kernels sharing
 # shapes never collide here.  Bounded (oldest-first eviction) so per-call
 # closure keys from long-running processes cannot pin memory forever.
-_COMPILE_LOG: Dict[Tuple[Any, Any], int] = {}
+_COMPILE_LOG: Dict[Tuple[Any, Any], int] = {}  # guarded-by: _LOCK
 _COMPILE_LOG_CAP = 4096
-_COMPILES = 0
-_COMPILE_TIME_S = 0.0
-_DISPATCH_HITS = 0
+_COMPILES = 0  # guarded-by: _LOCK
+_COMPILE_TIME_S = 0.0  # guarded-by: _LOCK
+_DISPATCH_HITS = 0  # guarded-by: _LOCK
 
 
 def _abstract_sig(args, kwargs):

@@ -286,7 +286,7 @@ class BlockShardedCC:
             return lab[None]
 
         spec = P(SHARD_AXIS)
-        fn = jax.jit(
+        fn = jax.jit(  # graft: disable=RAWJIT — keyed per (mesh, cap) in self._step_cache; a Mesh is not a stable process-global cache key
             shard_map(
                 step,
                 mesh=self.mesh,

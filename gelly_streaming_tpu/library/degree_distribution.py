@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 
@@ -85,7 +86,12 @@ class DegreeDistribution:
     """Continuous (degree, count) histogram-update stream."""
 
     def __init__(self):
-        self._kernel = jax.jit(degree_dist_update)
+        # graftcheck RAWJIT fix: per-instance jax.jit retraced this kernel
+        # for every fresh DegreeDistribution; the process-global cache
+        # compiles it once and meters retraces
+        self._kernel = compile_cache.cached_jit(
+            ("degree_dist_update",), lambda: degree_dist_update
+        )
 
     def run(self, stream) -> OutputStream:
         def blocks():

@@ -309,7 +309,7 @@ class MeshSampledTriangleCount:
             return a, b, beta
 
         spec = P(SHARD_AXIS)
-        self._step = jax.jit(
+        self._step = jax.jit(  # graft: disable=RAWJIT — per-mesh sharded step memoized on the instance; a Mesh is not a stable process-global cache key
             shard_map(
                 step,
                 mesh=self.mesh,

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.ops import unionfind as uf
@@ -29,11 +30,19 @@ class IterativeConnectedComponents:
     """Continuous (vertex, component) stream with on-device label propagation."""
 
     def __init__(self):
-        def kernel(parent, seen, src, dst, mask):
-            parent, seen = uf.union_edges_with_seen(parent, seen, src, dst, mask)
-            return parent, seen
+        def build():
+            def kernel(parent, seen, src, dst, mask):
+                parent, seen = uf.union_edges_with_seen(
+                    parent, seen, src, dst, mask
+                )
+                return parent, seen
 
-        self._kernel = jax.jit(kernel)
+            return kernel
+
+        # graftcheck RAWJIT fix: the kernel closes over nothing per-instance,
+        # so every IterativeConnectedComponents can share one executable via
+        # the process-global cache instead of re-jitting per construction
+        self._kernel = compile_cache.cached_jit(("iterative_cc_union",), build)
 
     def run(self, stream) -> OutputStream:
         cfg = stream.cfg

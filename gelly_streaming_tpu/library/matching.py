@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.utils.value_types import MatchingEvent
@@ -98,7 +99,11 @@ class CentralizedWeightedMatching:
     """Continuous MatchingEvent stream (ADD/REMOVE), single-shard stateful op."""
 
     def __init__(self):
-        self._kernel = jax.jit(matching_update)
+        # graftcheck RAWJIT fix: per-instance jax.jit retraced this kernel
+        # for every fresh matcher; the process-global cache compiles it once
+        self._kernel = compile_cache.cached_jit(
+            ("matching_update",), lambda: matching_update
+        )
 
     def run(self, stream) -> OutputStream:
         def records():

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
 
@@ -110,7 +111,11 @@ class _SampledTriangleCount:
     def __init__(self, num_samplers: int, seed: int = 0xDEADBEEF):
         self.num_samplers = num_samplers
         self.seed = seed
-        self._kernel = jax.jit(sampler_update)
+        # graftcheck RAWJIT fix: per-instance jax.jit retraced this kernel
+        # for every fresh estimator; the process-global cache compiles once
+        self._kernel = compile_cache.cached_jit(
+            ("sampler_update",), lambda: sampler_update
+        )
 
     def run(self, stream) -> OutputStream:
         """Continuous estimates: one record (estimate,) after each micro-batch."""

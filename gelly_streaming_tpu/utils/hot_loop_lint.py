@@ -1,135 +1,30 @@
 """AST lint: no blocking host syncs inside ``# hot-loop`` regions.
 
-The async window pipeline's whole premise (core/async_exec.py) is that the
-dispatch loops never wait on the device: a single ``np.asarray`` /
-``.item()`` / ``block_until_ready`` re-introduced into a dispatch loop
-silently turns the overlapped pipeline back into the one-RTT-per-window
-lockstep.  This checker pins that invariant as a tier-1 test
-(tests/test_hot_loop_lint.py) so future changes cannot regress it
-unnoticed.
-
-Markers (plain comments, so the regions are self-documenting in context):
-
-* ``# hot-loop`` — a standalone comment line opening a region (trailing
-  text after the marker is free-form description).
-* ``# hot-loop-end`` — closes the innermost open region.
-* ``# hot-loop-ok`` — trailing comment allowlisting ONE line inside a
-  region (the completion-queue drain is the sanctioned sync point).
-
-Inside a region, calls to ``np.asarray``/``numpy.asarray`` (or a bare
-``asarray``), any ``.item()`` method, and ``block_until_ready`` (method or
-``jax.block_until_ready``) are violations.  ``jnp.asarray`` is NOT flagged:
-a host->device transfer is pipeline work, not a sync.
+Migrated into the static-analysis framework as pass #0 — the
+implementation (and the full marker grammar) now lives in
+``gelly_streaming_tpu/analysis/hot_loop.py``; this module re-exports the
+original public API so existing callers and tests keep working unchanged.
+Run the whole suite with ``python -m gelly_streaming_tpu.analysis``.
 """
 
 from __future__ import annotations
 
-import ast
-import os
-from typing import List, Tuple
+from gelly_streaming_tpu.analysis.hot_loop import (  # noqa: F401
+    _FORBIDDEN_ATTRS,
+    _FORBIDDEN_BARE,
+    _FORBIDDEN_NP_FUNCS,
+    _NP_NAMES,
+    _regions,
+    _violation,
+    check_file,
+    check_paths,
+    check_source,
+    package_hot_loop_paths,
+)
 
-#: call shapes that block the caller on device results
-_FORBIDDEN_ATTRS = {"item", "block_until_ready"}
-_FORBIDDEN_NP_FUNCS = {"asarray"}
-_NP_NAMES = {"np", "numpy", "onp"}
-_FORBIDDEN_BARE = {"asarray", "block_until_ready"}
-
-
-def _regions(lines: List[str]) -> Tuple[List[Tuple[int, int]], List[str]]:
-    """(closed (start, end) 1-based line ranges, marker errors)."""
-    open_stack: List[int] = []
-    closed: List[Tuple[int, int]] = []
-    errors: List[str] = []
-    for i, line in enumerate(lines, start=1):
-        stripped = line.strip()
-        if stripped.startswith("#") and "hot-loop" in stripped:
-            body = stripped.lstrip("#").strip()
-            if body.startswith("hot-loop-end"):
-                if not open_stack:
-                    errors.append(f"line {i}: hot-loop-end without hot-loop")
-                else:
-                    closed.append((open_stack.pop(), i))
-            elif body.startswith("hot-loop-ok"):
-                pass  # allowlist marker on its own line: no region effect
-            elif body.startswith("hot-loop"):
-                open_stack.append(i)
-    for start in open_stack:
-        errors.append(f"line {start}: hot-loop region never closed")
-    return closed, errors
-
-
-def _violation(node: ast.Call) -> str | None:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        if fn.attr in _FORBIDDEN_ATTRS:
-            return f"{fn.attr}()"
-        if (
-            fn.attr in _FORBIDDEN_NP_FUNCS
-            and isinstance(fn.value, ast.Name)
-            and fn.value.id in _NP_NAMES
-        ):
-            return f"{fn.value.id}.{fn.attr}()"
-    elif isinstance(fn, ast.Name) and fn.id in _FORBIDDEN_BARE:
-        return f"{fn.id}()"
-    return None
-
-
-def check_source(source: str, filename: str = "<string>") -> List[str]:
-    """Lint one module's source; returns ``file:line: message`` strings."""
-    lines = source.splitlines()
-    regions, errors = _regions(lines)
-    problems = [f"{filename}:{e}" for e in errors]
-    if not regions:
-        return problems
-    tree = ast.parse(source, filename=filename)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        lineno = node.lineno
-        if not any(start < lineno < end for start, end in regions):
-            continue
-        what = _violation(node)
-        if what is None:
-            continue
-        line_src = lines[lineno - 1] if lineno <= len(lines) else ""
-        if "# hot-loop-ok" in line_src:
-            continue
-        problems.append(
-            f"{filename}:{lineno}: blocking host sync {what} inside a "
-            "# hot-loop region (move it to the completion-queue drain, or "
-            "allowlist the line with '# hot-loop-ok' and justify it)"
-        )
-    return problems
-
-
-def check_paths(paths) -> List[str]:
-    """Lint every ``.py`` file under the given files/directories."""
-    problems: List[str] = []
-    for path in paths:
-        if os.path.isdir(path):
-            for dirpath, _dirs, files in os.walk(path):
-                for name in sorted(files):
-                    if name.endswith(".py"):
-                        problems.extend(
-                            check_file(os.path.join(dirpath, name))
-                        )
-        else:
-            problems.extend(check_file(path))
-    return problems
-
-
-def check_file(path: str) -> List[str]:
-    with open(path) as f:
-        return check_source(f.read(), filename=path)
-
-
-def package_hot_loop_paths() -> List[str]:
-    """The directories whose hot-loop regions tier-1 pins: the core
-    runtime and the io planes (plus library/, which hosts the windowed
-    triangle loops)."""
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return [
-        os.path.join(root, "core"),
-        os.path.join(root, "io"),
-        os.path.join(root, "library"),
-    ]
+__all__ = [
+    "check_file",
+    "check_paths",
+    "check_source",
+    "package_hot_loop_paths",
+]

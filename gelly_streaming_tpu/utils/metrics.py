@@ -16,11 +16,19 @@ from typing import List, Optional
 
 
 class ThroughputMeter:
-    """Edges/sec over a processing run (count what the device actually saw)."""
+    """Edges/sec over a processing run (count what the device actually saw).
+
+    ``record_batch`` may be driven from any pipeline stage thread (pack /
+    transfer / drain), so the counters are lock-guarded — the unguarded
+    ``+=`` read-modify-write loses updates under contention (the lock-
+    discipline analyzer pass enforces the annotation, and
+    tests/test_metrics_threads.py hammers the no-lost-update behavior).
+    """
 
     def __init__(self):
-        self.edges = 0
-        self.batches = 0
+        self._lock = threading.Lock()
+        self.edges = 0  # guarded-by: _lock
+        self.batches = 0  # guarded-by: _lock
         self._start: Optional[float] = None
         self._stop: Optional[float] = None
 
@@ -30,8 +38,9 @@ class ThroughputMeter:
     def record_batch(self, num_edges: int) -> None:
         if self._start is None:
             self.start()
-        self.edges += int(num_edges)
-        self.batches += 1
+        with self._lock:
+            self.edges += int(num_edges)
+            self.batches += 1
 
     def stop(self) -> None:
         self._stop = time.perf_counter()
@@ -45,7 +54,9 @@ class ThroughputMeter:
 
     @property
     def edges_per_sec(self) -> float:
-        return self.edges / self.elapsed if self.elapsed > 0 else 0.0
+        with self._lock:
+            edges = self.edges
+        return edges / self.elapsed if self.elapsed > 0 else 0.0
 
 
 class WindowLatencyRecorder:
@@ -104,7 +115,10 @@ def _pipeline_zero() -> dict:
     }
 
 
-_PIPELINE = _pipeline_zero()
+# Bumped from the pack, transfer, dispatch, and drain threads at once; the
+# annotation is enforced by the lock-discipline analyzer pass, and
+# tests/test_metrics_threads.py pins the no-lost-update behavior.
+_PIPELINE = _pipeline_zero()  # guarded-by: _PIPE_LOCK
 
 
 def pipeline_add(key: str, amount: float) -> None:

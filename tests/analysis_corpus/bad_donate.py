@@ -1,0 +1,38 @@
+"""Seeded bug: touching buffers after donating them.
+
+Expected findings: exactly two DONATE — a read of a donated carry before
+rebinding, and a write into an arena after it was handed to the device.
+Analyzer input only — never imported.
+"""
+
+import numpy as np
+
+from gelly_streaming_tpu.core import compile_cache
+from gelly_streaming_tpu.core.async_exec import ArenaPool
+
+
+def _build():
+    def fold(state, buf):
+        return state
+
+    return fold
+
+
+fold = compile_cache.cached_jit(("corpus_fold",), _build, donate_argnums=0)
+pool = ArenaPool()
+
+
+def run(batches):
+    state = np.zeros(4)
+    for buf in batches:
+        out = fold(state, buf)
+        total = state.sum()  # BUG: state's buffer was donated to fold
+        state = out
+    return state, total
+
+
+def pack(pane):
+    src = pool.acquire((8,), np.int32)
+    dev = fold(src, pane)
+    src[0] = 1  # BUG: the in-flight fold may alias this memory zero-copy
+    return dev

@@ -1,0 +1,16 @@
+"""Seeded bug: a blocking host sync inside a '# hot-loop' region.
+
+Expected findings: exactly one HOTSYNC.
+Analyzer input only — never imported.
+"""
+
+import numpy as np
+
+
+def drain(xs):
+    out = []
+    # hot-loop: dispatch loop
+    for x in xs:
+        out.append(np.asarray(x))  # BUG: one sync restores lockstep
+    # hot-loop-end
+    return out

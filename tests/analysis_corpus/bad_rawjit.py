@@ -1,0 +1,16 @@
+"""Seeded bug: raw jax.jit call sites bypassing the executable cache.
+
+Expected findings: exactly two RAWJIT (decorator + call form).
+This file is analyzer input only — it is never imported.
+"""
+
+import jax
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def make_stream_step(state_fn):
+    return jax.jit(state_fn, donate_argnums=0)

@@ -1,0 +1,29 @@
+"""Seeded bug: Python control flow / coercions on traced values inside
+compile-cache-dispatched kernels.
+
+Expected findings: exactly one TRACEIF (the value branch) and two
+TRACECAST (the int() coercion and the .item() read).
+Analyzer input only — never imported.
+"""
+
+from gelly_streaming_tpu.core import compile_cache
+
+
+def make():
+    def kernel(x, n, flag):
+        if x > 0:  # BUG: value branch concretizes the tracer
+            return x
+        return x + int(n)  # BUG: int() is a host sync on a tracer
+
+    return kernel
+
+
+def make_reader():
+    def reader(y):
+        return y.item()  # BUG: .item() concretizes the tracer
+
+    return reader
+
+
+step = compile_cache.cached_jit(("corpus_trace",), make, static_argnums=(2,))
+read = compile_cache.cached_jit(("corpus_read",), make_reader)

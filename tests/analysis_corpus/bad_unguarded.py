@@ -1,0 +1,25 @@
+"""Seeded bug: '# guarded-by:' state touched without its lock.
+
+Expected findings: exactly two UNGUARDED — a module global bumped without
+'with _LOCK:' and an instance attribute bumped without 'with self._lock:'.
+Analyzer input only — never imported.
+"""
+
+import threading
+
+_LOCK = threading.Lock()
+_COUNT = 0  # guarded-by: _LOCK
+
+
+def bump():
+    global _COUNT
+    _COUNT += 1  # BUG: lost-update window — two threads read the same value
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: _lock
+
+    def add(self, n):
+        self.total += n  # BUG: same lost-update window on the instance

@@ -1,0 +1,37 @@
+"""Clean counterpart: donated carries rebound, arenas untouched until the
+sanctioned drain point.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import numpy as np
+
+from gelly_streaming_tpu.core import compile_cache
+from gelly_streaming_tpu.core.async_exec import ArenaPool, wait_ready
+
+
+def _build():
+    def fold(state, buf):
+        return state
+
+    return fold
+
+
+fold = compile_cache.cached_jit(("corpus_fold_ok",), _build, donate_argnums=0)
+pool = ArenaPool()
+
+
+def run(batches):
+    state = np.zeros(4)
+    for buf in batches:
+        state = fold(state, buf)  # donated-carry pattern: rebind immediately
+    return state
+
+
+def pack_and_drain(pane):
+    src = pool.acquire((8,), np.int32)
+    src[:4] = pane  # writes while owned (before hand-off) are fine
+    dev = fold(src, pane)
+    wait_ready(dev)  # the fold completed: the arena is no longer read
+    pool.release(src)  # arena-live-until: drain
+    return dev

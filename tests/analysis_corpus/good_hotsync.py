@@ -1,0 +1,21 @@
+"""Clean counterpart: the one sanctioned sync is allowlisted — on the
+CLOSING line of a multi-line call (the satellite regression: the marker
+must be honored on any physical line of the call, not just its first).
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import numpy as np
+
+
+def drain(xs):
+    out = []
+    # hot-loop: dispatch loop
+    for x in xs:
+        out.append(
+            np.asarray(
+                x
+            )  # hot-loop-ok: completion-queue drain, the sanctioned sync
+        )
+    # hot-loop-end
+    return out

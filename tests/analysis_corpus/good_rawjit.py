@@ -1,0 +1,22 @@
+"""Clean counterpart: the same kernels routed through the executable cache.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+from gelly_streaming_tpu.core import compile_cache
+
+
+def make():
+    def kernel(x):
+        return x + 1
+
+    return kernel
+
+
+step = compile_cache.cached_jit(("corpus_kernel",), make)
+
+
+def make_stream_step(state_fn):
+    return compile_cache.cached_jit(
+        ("corpus_stream_step", state_fn), lambda: state_fn, donate_argnums=0
+    )

@@ -1,0 +1,25 @@
+"""Clean counterpart: static-parameter branches, structural tests, and
+on-device selects only.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import jax.numpy as jnp
+
+from gelly_streaming_tpu.core import compile_cache
+
+
+def make():
+    def kernel(x, n, flag):
+        if flag:  # static_argnums parameter: concrete by contract
+            return jnp.where(x > 0, x, n)  # value select stays on device
+        if x is None:  # structural: decided at trace time
+            return n
+        if x.shape[0] > 2:  # shapes are trace-time constants
+            return x + n
+        return x - n
+
+    return kernel
+
+
+step = compile_cache.cached_jit(("corpus_trace_ok",), make, static_argnums=(2,))

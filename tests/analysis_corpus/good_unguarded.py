@@ -1,0 +1,34 @@
+"""Clean counterpart: every guarded access holds its lock, and a provably
+single-threaded reader carries the '# single-thread:' marker.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+_LOCK = threading.Lock()
+_COUNT = 0  # guarded-by: _LOCK
+
+
+def bump():
+    global _COUNT
+    with _LOCK:
+        _COUNT += 1
+
+
+def report():  # single-thread: read at teardown, after every worker joined
+    return _COUNT
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: _lock
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def snapshot(self):
+        with self._lock:
+            return self.total

@@ -1,0 +1,328 @@
+"""graftcheck (tier-1): the static-analysis suite holds the shipped tree to
+zero unsuppressed findings, and each pass provably catches its seeded
+defect.
+
+Three layers, mirroring the framework's contract:
+
+* the PACKAGE GATE — running every pass over core/io/library/parallel/utils
+  (plus the shipped baseline) must come back clean, so a new raw jit, an
+  unguarded counter, or a use-after-donate fails tier-1 at the line that
+  introduced it;
+* the FIXTURE CORPUS — one good + one seeded-bad snippet per pass under
+  tests/analysis_corpus/, asserting exact finding codes (a checker that
+  finds nothing anywhere must fail here, not pass vacuously);
+* the FRAMEWORK — suppression grammar, baseline round-trip (grandfathered
+  counts, new-finding overflow), finding format, CLI driver exit codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from gelly_streaming_tpu import analysis
+
+CORPUS = os.path.join(os.path.dirname(__file__), "analysis_corpus")
+REPO_ROOT = os.path.dirname(analysis.package_root())
+
+
+def _analyze(path):
+    return analysis.analyze_file(os.path.join(CORPUS, path))
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _src(snippet, filename="probe.py"):
+    return analysis.analyze_source(textwrap.dedent(snippet), filename)
+
+
+# ---------------------------------------------------------------------------
+# package gate
+
+
+def _package_paths():
+    root = analysis.package_root()
+    return [
+        os.path.join(root, d)
+        for d in ("core", "io", "library", "parallel", "utils")
+    ]
+
+
+@pytest.mark.timeout_cap(120)
+def test_package_tree_is_clean():
+    findings = analysis.analyze_paths(_package_paths(), root=REPO_ROOT)
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    new, _old = analysis.apply_baseline(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_baseline_is_small_and_rawjit_only():
+    """The baseline exists to grandfather the module-scope @jax.jit
+    decorators, not to absorb new debt: pin its size and composition so
+    quietly re-baselining a regression shows up as a diff here."""
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    assert sum(baseline.values()) <= 6
+    assert all(code == "RAWJIT" for (_p, code, _m) in baseline)
+
+
+@pytest.mark.timeout_cap(120)
+def test_cli_package_scan_exits_zero():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "gelly_streaming_tpu.analysis",
+            "--paths",
+            "core",
+            "io",
+            "library",
+            "parallel",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stderr
+
+
+def test_cli_list_passes_names_all_five():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "gelly_streaming_tpu.analysis",
+            "--list-passes",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0
+    for name in (
+        "hot-loop",
+        "jit-discipline",
+        "donation-safety",
+        "lock-discipline",
+        "trace-safety",
+    ):
+        assert name in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: each pass catches exactly its seeded defect
+
+
+def test_corpus_rawjit():
+    assert _codes(_analyze("bad_rawjit.py")) == ["RAWJIT", "RAWJIT"]
+    assert _analyze("good_rawjit.py") == []
+
+
+def test_corpus_donate():
+    findings = _analyze("bad_donate.py")
+    assert _codes(findings) == ["DONATE", "DONATE"]
+    # one per seeded bug: the donated-carry read and the arena write
+    assert "state" in findings[0].message and "src" in findings[1].message
+    assert _analyze("good_donate.py") == []
+
+
+def test_corpus_unguarded():
+    findings = _analyze("bad_unguarded.py")
+    assert _codes(findings) == ["UNGUARDED", "UNGUARDED"]
+    assert "_COUNT" in findings[0].message
+    assert "self.total" in findings[1].message
+    assert _analyze("good_unguarded.py") == []
+
+
+def test_corpus_traceif():
+    assert _codes(_analyze("bad_traceif.py")) == [
+        "TRACECAST",
+        "TRACECAST",
+        "TRACEIF",
+    ]
+    assert _analyze("good_traceif.py") == []
+
+
+def test_corpus_hotsync():
+    assert _codes(_analyze("bad_hotsync.py")) == ["HOTSYNC"]
+    # the good twin hangs '# hot-loop-ok' on the CLOSING line of a
+    # multi-line call — the satellite regression for hot_loop_lint's
+    # original single-line marker scan
+    assert _analyze("good_hotsync.py") == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def test_trailing_suppression_silences_one_code():
+    findings = _src(
+        """
+        import jax
+
+        step = jax.jit(lambda x: x)  # graft: disable=RAWJIT — probe justification
+        """
+    )
+    assert findings == []
+
+
+def test_standalone_suppression_on_line_above():
+    findings = _src(
+        """
+        import jax
+
+        # graft: disable=RAWJIT — decorator form cannot carry a trailing comment here
+        @jax.jit
+        def f(x):
+            return x
+        """
+    )
+    assert findings == []
+
+
+def test_suppression_is_code_specific():
+    findings = _src(
+        """
+        import jax
+
+        step = jax.jit(lambda x: x)  # graft: disable=DONATE — wrong code
+        """
+    )
+    assert _codes(findings) == ["RAWJIT"]
+
+
+def test_suppression_above_a_code_line_does_not_leak_down():
+    findings = _src(
+        """
+        import jax
+
+        a = jax.jit(lambda x: x)  # graft: disable=RAWJIT — this line only
+        b = jax.jit(lambda x: x)
+        """
+    )
+    assert _codes(findings) == ["RAWJIT"]
+    assert findings[0].line == 5
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def test_baseline_round_trip_and_overflow(tmp_path):
+    src = textwrap.dedent(
+        """
+        import jax
+
+        a = jax.jit(lambda x: x)
+        b = jax.jit(lambda x: x)
+        """
+    )
+    findings = analysis.analyze_source(src, "probe.py")
+    assert len(findings) == 2
+    path = str(tmp_path / "baseline.json")
+    analysis.write_baseline(findings, path)
+    baseline = analysis.load_baseline(path)
+    new, old = analysis.apply_baseline(findings, baseline)
+    assert new == [] and len(old) == 2
+    # a THIRD identical finding exceeds the grandfathered count: reported
+    src3 = src + "c = jax.jit(lambda x: x)\n"
+    findings3 = analysis.analyze_source(src3, "probe.py")
+    new3, old3 = analysis.apply_baseline(findings3, baseline)
+    assert len(new3) == 1 and len(old3) == 2
+
+
+def test_baseline_file_shape(tmp_path):
+    f = analysis.Finding("p.py", 3, "jit-discipline", "RAWJIT", "msg")
+    path = str(tmp_path / "b.json")
+    analysis.write_baseline([f, f], path)
+    data = json.load(open(path))
+    assert data["findings"] == [
+        {"path": "p.py", "code": "RAWJIT", "message": "msg", "count": 2}
+    ]
+
+
+# ---------------------------------------------------------------------------
+# framework details
+
+
+def test_finding_format_is_machine_readable():
+    f = analysis.Finding("a/b.py", 7, "lock-discipline", "UNGUARDED", "boom")
+    assert f.format() == "a/b.py:7: [lock-discipline/UNGUARDED] boom"
+
+
+def test_syntax_error_is_a_parse_finding():
+    findings = _src("def broken(:\n")
+    assert _codes(findings) == ["PARSE"]
+
+
+def test_registry_has_five_passes_in_order():
+    passes = list(analysis.load_passes())
+    assert passes == [
+        "hot-loop",
+        "jit-discipline",
+        "donation-safety",
+        "lock-discipline",
+        "trace-safety",
+    ]
+
+
+def test_lock_pass_respects_with_and_single_thread():
+    findings = _src(
+        """
+        import threading
+
+        _L = threading.Lock()
+        _N = 0  # guarded-by: _L
+
+        def ok():
+            global _N
+            with _L:
+                _N += 1
+
+        def also_ok():  # single-thread: driver loop
+            return _N
+
+        def bad():
+            return _N
+        """
+    )
+    assert _codes(findings) == ["UNGUARDED"]
+    assert findings[0].line == 16
+
+
+def test_donation_pass_drain_marker_ends_liveness():
+    findings = _src(
+        """
+        from gelly_streaming_tpu.core import compile_cache
+
+        fold = compile_cache.cached_jit(("k",), lambda: None, donate_argnums=0)
+
+        def f(state, buf):
+            out = fold(state, buf)
+            # arena-live-until: drain
+            return state, out
+        """
+    )
+    assert findings == []
+
+
+def test_trace_pass_sees_shard_map_wrapped_defs():
+    findings = _src(
+        """
+        import jax
+        from gelly_streaming_tpu.parallel.mesh import shard_map
+
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+
+        fn = jax.jit(shard_map(step, mesh=None, in_specs=(), out_specs=()))  # graft: disable=RAWJIT — probe
+        """
+    )
+    assert _codes(findings) == ["TRACEIF"]

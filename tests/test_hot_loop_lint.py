@@ -113,6 +113,51 @@ def test_hot_loop_ok_allowlists_single_line():
     assert len(problems) == 1
 
 
+def test_hot_loop_ok_honored_on_multiline_call_closing_line():
+    """Regression: the allowlist marker must be honored on ANY physical
+    line of the flagged call — a black-formatted multi-line call hangs its
+    trailing comment on the closing paren line, which the original
+    single-line scan (node.lineno only) missed."""
+    problems = _lint(
+        """
+        import numpy as np
+
+        def f(xs):
+            out = []
+            # hot-loop
+            for x in xs:
+                out.append(
+                    np.asarray(
+                        x
+                    )  # hot-loop-ok: completion-queue drain
+                )
+            # hot-loop-end
+            return out
+        """
+    )
+    assert problems == []
+
+
+def test_multiline_call_without_marker_still_flagged():
+    problems = _lint(
+        """
+        import numpy as np
+
+        def f(xs):
+            # hot-loop
+            ys = [
+                np.asarray(
+                    x
+                )
+                for x in xs
+            ]
+            # hot-loop-end
+            return ys
+        """
+    )
+    assert len(problems) == 1 and "np.asarray()" in problems[0]
+
+
 def test_unclosed_region_is_an_error():
     problems = _lint(
         """

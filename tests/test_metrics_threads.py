@@ -1,0 +1,83 @@
+"""Lost-update repro (tier-1): the pipeline counters and the throughput
+meter take concurrent bumps from the pack / transfer / drain threads
+without dropping any.
+
+The unguarded ``self.edges += n`` read-modify-write has a preemption window
+between the LOAD and the STORE; with the switch interval cranked down the
+window is hit reliably, so these tests FAIL (flakily, the nature of the
+bug) without the locks and pass deterministically with them — the
+lock-discipline analyzer pass (tests/test_analysis.py) pins the guard
+statically so the fix cannot quietly regress either way.
+"""
+
+import sys
+import threading
+
+import pytest
+
+from gelly_streaming_tpu.utils import metrics
+
+THREADS = 8
+ITERS = 5000
+
+
+def _hammer(fn):
+    """Run ``fn`` from THREADS threads with an aggressive switch interval."""
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        start = threading.Barrier(THREADS)
+
+        def worker():
+            start.wait()
+            for _ in range(ITERS):
+                fn()
+
+        ts = [threading.Thread(target=worker) for _ in range(THREADS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+
+
+@pytest.mark.timeout_cap(120)
+def test_throughput_meter_no_lost_updates():
+    meter = metrics.ThroughputMeter()
+    _hammer(lambda: meter.record_batch(3))
+    assert meter.edges == 3 * THREADS * ITERS
+    assert meter.batches == THREADS * ITERS
+
+
+@pytest.mark.timeout_cap(120)
+def test_pipeline_counters_no_lost_updates():
+    metrics.reset_pipeline_stats()
+    try:
+        _hammer(
+            lambda: metrics.pipeline_add("pipeline_windows_dispatched", 1)
+        )
+        stats = metrics.pipeline_stats()
+        assert stats["pipeline_windows_dispatched"] == THREADS * ITERS
+    finally:
+        # process-global counters: leave them zeroed for other tests
+        metrics.reset_pipeline_stats()
+
+
+@pytest.mark.timeout_cap(120)
+def test_pipeline_high_water_is_max_under_contention():
+    metrics.reset_pipeline_stats()
+    try:
+        values = list(range(THREADS * ITERS))
+        it_lock = threading.Lock()
+
+        def bump():
+            with it_lock:
+                v = values.pop()
+            metrics.pipeline_high_water("pipeline_inflight_high_water", v)
+
+        _hammer(bump)
+        stats = metrics.pipeline_stats()
+        assert stats["pipeline_inflight_high_water"] == THREADS * ITERS - 1
+    finally:
+        metrics.reset_pipeline_stats()
