@@ -109,6 +109,15 @@ sequences matched bit-for-bit and ``async_cache_recompiles`` that the async
 plane stayed at zero recompiles.  The ``pipeline_*`` keys are the
 occupancy counters (utils/metrics.pipeline_stats): in-flight window
 high-water mark, per-stage stall seconds, prefetch depth, window counts.
+
+Mesh-comms keys (ISSUE 4): the ``comms_*`` counters
+(utils/metrics.comms_stats) meter the owner-sharded summary plane —
+per-dispatch collective byte volume split into delta-exchange vs
+emit/snapshot-gather traffic, exchange round counts, and the
+delta-occupancy high-water mark.  The single-chip headline leaves them at
+zero; the multichip scaling sweep (__graft_entry__ stage D) reports the
+same counters as bytes/edge per shard count, where the O(C/S + delta)
+claim is asserted.
 """
 
 import ctypes
@@ -845,6 +854,14 @@ def main():
     # ---- static-analysis attestation: the artifact doubles as a proof the
     # measured tree passes graftcheck (0 = clean; a positive count means the
     # bench ran on a tree whose invariants the suite no longer pins)
+    # mesh-comms counters (owner-sharded summary plane, ISSUE 4): zero on
+    # the single-chip headline, populated when a mesh plane ran in-process —
+    # the keys are first-class so the artifact schema is stable either way
+    from gelly_streaming_tpu.utils import metrics as _metrics
+
+    comms_stats = _metrics.comms_stats()
+    _PARTIAL.update(comms_stats)
+
     analysis_stats = {}
     try:
         from gelly_streaming_tpu import analysis as _analysis
@@ -1279,6 +1296,7 @@ def main():
                 **cache_guard,
                 **async_stats,
                 **analysis_stats,
+                **comms_stats,
             }
         )
     )

@@ -29,6 +29,13 @@ Passes (one module each, registered on import):
   #4 ``trace-safety``    TRACEIF/TRACECAST — Python control flow on traced
                          parameters and int()/bool()/float()/.item()
                          coercions inside compile-cache-dispatched kernels.
+  #5 ``collective-discipline``
+                         COLLGATHER — full-state gathers (``lax.all_gather``,
+                         ``gather_blocks``/``gather_state``) outside
+                         sanctioned ``# gather-ok: <why>`` emit/snapshot
+                         sites: streaming-step kernels must reconcile via
+                         delta buffers (the owner-sharded summary plane's
+                         O(C/S + delta) comms invariant, ISSUE 4).
 
 Finding format: ``file:line: [PASS/CODE] message``.
 
@@ -175,6 +182,7 @@ def load_passes() -> Dict[str, Pass]:
     from gelly_streaming_tpu.analysis import donation  # noqa: F401
     from gelly_streaming_tpu.analysis import locks  # noqa: F401
     from gelly_streaming_tpu.analysis import trace_safety  # noqa: F401
+    from gelly_streaming_tpu.analysis import collectives  # noqa: F401
 
     return dict(_REGISTRY)
 

@@ -1,0 +1,114 @@
+"""Pass #5 — ``collective-discipline``: full-state gathers stay at
+emit/snapshot boundaries.
+
+The owner-sharded summary plane (ISSUE 4, core/sharded_state.py) exists to
+kill the per-dispatch ``all_gather`` of full partial summaries — the O(C*S)
+comms term that inverted the multichip scaling quadrant.  Its invariant is
+structural, not typed: streaming-step kernels reconcile cross-shard state
+through fixed-capacity DELTA buffers (parallel/routing.exchange_slab_deltas),
+and the replicated full view is reassembled (``gather_blocks`` /
+``lax.all_gather``) only where an emission, snapshot, or sanctioned fallback
+demands it.  One undisciplined gather inside a per-batch kernel silently
+reintroduces the O(C*S) wall and no test would notice until the scaling
+sweep regresses.
+
+Flagged (code COLLGATHER):
+
+* every ``all_gather`` attribute reference (``lax.all_gather``,
+  ``jax.lax.all_gather``), and
+* every call to a function named ``gather_blocks`` or ``gather_state``
+  (the framework's block-reassembly helpers),
+
+unless some physical line of the statement carries a ``# gather-ok: <why>``
+comment naming the sanction (``emit``, ``snapshot``, the fallback oracle,
+or the exchange internals) — the why is required, a bare ``# gather-ok``
+does not suppress.  ``# graft: disable=COLLGATHER`` works as everywhere
+else.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from gelly_streaming_tpu import analysis
+
+_GATHER_HELPERS = {"gather_blocks", "gather_state"}
+_OK_RE = re.compile(r"#\s*gather-ok:\s*\S")
+
+_MESSAGE = (
+    "full-state gather in reach of a streaming-step kernel — reconcile "
+    "through delta buffers (routing.exchange_slab_deltas) and gather the "
+    "replicated view only at emit/snapshot boundaries; sanction a "
+    "legitimate boundary site with `# gather-ok: <why>`"
+)
+
+
+class CollectiveDisciplinePass(analysis.Pass):
+    name = "collective-discipline"
+    codes = ("COLLGATHER",)
+    description = "all_gather/gather_blocks only at `# gather-ok:` sites"
+
+    def _sanctioned(
+        self, sf: analysis.SourceFile, node: ast.AST, stmt: ast.AST
+    ) -> bool:
+        # the marker is honored on ANY physical line of the enclosing
+        # statement (a wrapped all_gather call may hang it on the
+        # closing-paren line), same contract as # hot-loop-ok.  Compound
+        # statements (if/for/def — anything with a body) would span their
+        # whole suite, so for those only the node's own lines count.
+        start = node.lineno
+        end = getattr(node, "end_lineno", start) or start
+        if stmt is not None and not hasattr(stmt, "body"):
+            start = min(start, stmt.lineno)
+            end = max(end, getattr(stmt, "end_lineno", end) or end)
+        return any(
+            _OK_RE.search(sf.comment(i)) for i in range(start, end + 1)
+        )
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        #: nearest statement ancestor-or-self per node (the sanction span —
+        #: a stmt child records ITSELF so nested exprs resolve to their own
+        #: line-spanning statement, never a whole enclosing def)
+        stmt_of = {}
+        for parent in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(parent):
+                if isinstance(child, ast.stmt):
+                    stmt_of[child] = child
+                elif isinstance(parent, ast.stmt):
+                    stmt_of[child] = parent
+                else:
+                    stmt_of[child] = stmt_of.get(parent)
+        for node in ast.walk(sf.tree):
+            hit = None
+            if isinstance(node, ast.Attribute) and node.attr == "all_gather":
+                hit = "lax.all_gather"
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                name = (
+                    fn.id
+                    if isinstance(fn, ast.Name)
+                    else fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                if name in _GATHER_HELPERS:
+                    hit = name
+            if hit is None:
+                continue
+            if self._sanctioned(sf, node, stmt_of.get(node)):
+                continue
+            out.append(
+                sf.finding(
+                    node.lineno,
+                    self.name,
+                    "COLLGATHER",
+                    f"{hit}: {_MESSAGE}",
+                )
+            )
+        return out
+
+
+analysis.register(CollectiveDisciplinePass())

@@ -144,8 +144,20 @@ class DonationSafetyPass(analysis.Pass):
                         getattr(n.value, "end_col_offset", 0) + 1,
                     )
                     for t in n.targets:
-                        if isinstance(t, ast.Name):
-                            store_keys[(t.lineno, t.col_offset)] = after_value
+                        # tuple/list unpacking rebinds each element name the
+                        # same way a single-name target does — without this,
+                        # ``a, b = f(a, b)`` with donated args reads as a
+                        # use-after-donation on the NEXT access of a or b
+                        elems = (
+                            t.elts
+                            if isinstance(t, (ast.Tuple, ast.List))
+                            else [t]
+                        )
+                        for el in elems:
+                            if isinstance(el, ast.Name):
+                                store_keys[(el.lineno, el.col_offset)] = (
+                                    after_value
+                                )
 
             def walk(n):
                 for child in ast.iter_child_nodes(n):

@@ -109,6 +109,17 @@ class SummaryAggregation:
         """
         return None
 
+    def sharded_state_spec(self, cfg: StreamConfig):
+        """Optional owner-sharded summary state protocol (ISSUE 4).
+
+        Return a ``core.sharded_state.ShardedStateSpec`` to make O(C/S)
+        owner blocks + delta-exchange reconciliation the descriptor's mesh
+        streaming plane (the default path when supported and enabled —
+        ``cfg.sharded_state`` / GELLY_SHARDED_STATE); None keeps the
+        replicated combine above, which remains the equivalence oracle.
+        """
+        return None
+
     # -- execution ------------------------------------------------------------
 
     def _num_partitions(self, cfg: StreamConfig) -> int:
@@ -854,6 +865,27 @@ class SummaryAggregation:
             return -1, False  # legacy layout: merge loop sorts it out
         return int(snap["last_window"]), bool(snap["global_done"])
 
+    def _restored_summary(self, cfg, checkpoint_path, restore):
+        """The snapshot's running summary pytree, or None — for seeding
+        persistent sharded blocks on resume (the merge loop re-reads the
+        position itself and stays the source of truth)."""
+        if not (checkpoint_path and restore):
+            return None
+        from gelly_streaming_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_state,
+        )
+
+        if not checkpoint_exists(checkpoint_path):
+            return None
+        try:
+            snap = load_state(checkpoint_path, self._checkpoint_like(cfg))
+        except ValueError:
+            return None
+        if not bool(snap["has_summary"]):
+            return None
+        return snap["summary"]
+
     def _async_pane_records(
         self,
         stream,
@@ -1083,10 +1115,16 @@ class SummaryAggregation:
         restore: bool,
         unwrap: bool = False,
         release: Optional[Callable] = None,
+        fold_is_running: bool = False,
     ) -> Iterator[tuple]:
         """The Merger: running merge + emission + positional checkpointing
         (SummaryAggregation.java:93-135), shared by the simulated and mesh
         execution paths so their recovery semantics cannot diverge.
+
+        ``fold_is_running`` (the owner-sharded plane): ``fold_pane`` folds
+        into PERSISTENT cross-window state and returns the running summary
+        itself, so the loop skips the combine step — emission order,
+        transient resets, and checkpoint semantics are unchanged.
 
         ``fold_pane(pane) -> summary | None`` supplies the per-pane partial
         fold+combine; everything downstream (merge order, transient reset,
@@ -1117,6 +1155,7 @@ class SummaryAggregation:
                 unwrap=unwrap,
                 depth=depth,
                 release=release,
+                fold_is_running=fold_is_running,
             )
             return
         running = None
@@ -1151,7 +1190,7 @@ class SummaryAggregation:
                 continue
             # Merger: non-blocking running merge, one emission per window
             # close (SummaryAggregation.java:107-119).
-            if running is None or self.transient_state:
+            if running is None or self.transient_state or fold_is_running:
                 running = pane_summary
             else:
                 running = self._combine_j(running, pane_summary)
@@ -1282,9 +1321,9 @@ class MeshAggregationRunner:
 
         def gather_combine(state, has_data):
             gathered = jax.tree.map(
-                lambda a: jax.lax.all_gather(a, axis), state
+                lambda a: jax.lax.all_gather(a, axis), state  # gather-ok: replicated fallback combine — the equivalence oracle for the sharded plane
             )
-            has = jax.lax.all_gather(has_data, axis)
+            has = jax.lax.all_gather(has_data, axis)  # gather-ok: replicated fallback combine — the equivalence oracle for the sharded plane
             parts = [
                 (jax.tree.map(lambda g: g[i], gathered), has[i])
                 for i in range(n)
@@ -1662,6 +1701,316 @@ class MeshAggregationRunner:
             },
         )
 
+    # -- owner-sharded summary plane (core/sharded_state.py, ISSUE 4) --------
+
+    def _sharded_spec(self, cfg: StreamConfig):
+        """The descriptor's ShardedStateSpec when the owner-sharded plane is
+        enabled and usable here.  Multi-process meshes stay on the
+        replicated plane (their per-process snapshot machinery predates the
+        block layout), as does anything with ``cfg.sharded_state`` off."""
+        from gelly_streaming_tpu.core.sharded_state import resolve_sharded_state
+
+        if jax.process_count() > 1 or not resolve_sharded_state(cfg):
+            return None
+        return self.agg.sharded_state_spec(cfg)
+
+    def _shard_ctx(self, cfg: StreamConfig, spec, interval_edges: int):
+        """Static per-step context; the delta capacity pow2-buckets the
+        spec's changed-row bound for one exchange interval."""
+        from gelly_streaming_tpu.core.sharded_state import ShardContext
+        from gelly_streaming_tpu.parallel import routing
+
+        cap = routing.delta_capacity(
+            cfg.vertex_capacity,
+            self.num_shards,
+            spec.delta_bound(cfg, interval_edges),
+        )
+        return ShardContext(
+            cfg=cfg,
+            num_shards=self.num_shards,
+            axis_name=self._axis,
+            delta_cap=cap,
+        )
+
+    def _sharded_key(self, spec, cfg: StreamConfig, *extra):
+        """Process-stable executable-cache key for a sharded mesh kernel.
+
+        Unlike the legacy ``_step_cache`` (per-runner, raw jax.jit — invisible
+        to the retrace guard), sharded kernels live in the process-global
+        compile cache: ``mesh_cache_key`` makes re-created runners over the
+        same devices resolve to the same executables, and the bench's
+        ``cache_recompiles`` attestation covers this plane too.
+        """
+        from gelly_streaming_tpu.parallel.mesh import mesh_cache_key
+
+        return (
+            type(spec),
+            self.agg.cache_token,
+            mesh_cache_key(self.mesh),
+            cfg,
+        ) + extra
+
+    def _record_exchange_stats(self, profile: dict, stats_host) -> None:
+        """Fold one exchange's [S, 3] device-counter download into the
+        process comms metrics (called at exchange boundaries only)."""
+        from gelly_streaming_tpu.utils import metrics
+
+        stats = np.asarray(stats_host)
+        rounds = int(stats[:, 0].max())
+        metrics.comms_add("comms_exchange_rounds", rounds)
+        metrics.comms_high_water(
+            "comms_delta_occupancy_hwm", int(stats[:, 1].max())
+        )
+        metrics.comms_add("comms_delta_spilled", int(stats[:, 2].sum()))
+        metrics.comms_add(
+            "comms_bytes_exchange", rounds * profile["round_nbytes"]
+        )
+
+    def _sharded_blocks_sharding(self):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self._axis))
+
+    def _initial_blocks(self, spec, cfg: StreamConfig):
+        return jax.device_put(
+            spec.initial_shard_state(cfg, self.num_shards),
+            self._sharded_blocks_sharding(),
+        )
+
+    def _sharded_wire_fns(self, cfg: StreamConfig, spec, stages, row_len, width, ctx):
+        """(exchange, gather) pair for the sharded wire plane.
+
+        ``exchange``: donated (carry, blocks) -> (carry', blocks', stats) —
+        folds the per-shard local partial into the owner blocks through the
+        spec's delta exchange and resets the local scratch (the carry keeps
+        streaming through the SAME per-dispatch step as the replicated
+        plane, so the hot path pays zero extra collectives).  ``gather``:
+        blocks -> the replicated summary, emit/snapshot boundaries only.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from gelly_streaming_tpu.parallel.mesh import shard_map
+
+        agg = self.agg
+        spec_ = P(self._axis)
+
+        def strip(t):
+            return jax.tree.map(lambda a: a[0], t)
+
+        def lift(t):
+            return jax.tree.map(lambda a: a[None], t)
+
+        def make_exchange():
+            def ex(carry, blocks):
+                states, summary, touched = carry
+                blocks2, stats = spec.exchange(strip(summary), strip(blocks), ctx)
+                fresh = agg.initial_state(cfg)
+                stats_row = jnp.stack(
+                    [stats.rounds, stats.delta_hwm, stats.spilled]
+                ).astype(jnp.int32)
+                return (
+                    (states, lift(fresh), touched),
+                    lift(blocks2),
+                    stats_row[None],
+                )
+
+            return shard_map(
+                ex,
+                mesh=self.mesh,
+                in_specs=(spec_, spec_),
+                out_specs=(spec_, spec_, spec_),
+            )
+
+        def make_gather():
+            def g(blocks):
+                return spec.gather_state(strip(blocks), ctx)  # gather-ok: emit/snapshot boundary — the lazy replicated view
+
+            return shard_map(
+                g, mesh=self.mesh, in_specs=(spec_,), out_specs=P()
+            )
+
+        exchange = compile_cache.cached_jit(
+            ("mesh_sharded_wire_exchange",)
+            + self._sharded_key(spec, cfg, stages, row_len, str(width), ctx.delta_cap),
+            make_exchange,
+            donate_argnums=(0, 1),
+        )
+        gather = compile_cache.cached_jit(
+            ("mesh_sharded_gather",)
+            + self._sharded_key(spec, cfg, ctx.delta_cap),
+            make_gather,
+        )
+        return exchange, gather
+
+    def _wire_sharded_checkpoint_like(self, stream, spec, row_len: int):
+        """Sharded wire snapshot layout: O(C/S) owner blocks per shard (the
+        S-fold download shrink vs the replicated carry), stage states, and
+        the group position — same geometry validation as the replicated
+        layout."""
+        cfg = stream.cfg
+        like = self._wire_mesh_checkpoint_like(stream, row_len)
+        del like["summary"], like["touched"]
+        like["blocks"] = jax.tree.map(
+            np.asarray, spec.initial_shard_state(cfg, self.num_shards)
+        )
+        return like
+
+    def _wire_records_sharded(
+        self,
+        stream,
+        spec,
+        checkpoint_path: Optional[str],
+        restore: bool,
+    ) -> Iterator[tuple]:
+        """Owner-sharded form of ``wire_records``.
+
+        Per dispatch the stream rides the IDENTICAL donated-carry step as
+        the replicated plane (local folds, no collectives); at snapshot
+        boundaries and stream end the local partials delta-exchange into the
+        O(C/S) owner blocks ("changed keys since last exchange"), and the
+        replicated view is gathered lazily only to emit.  Snapshots download
+        blocks — O(C) total across the mesh instead of O(C*S).
+        """
+        from gelly_streaming_tpu.io import wire as wire_mod
+        from gelly_streaming_tpu.utils import metrics
+        from gelly_streaming_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_state,
+            save_state,
+        )
+
+        cfg = stream.cfg
+        agg = self.agg
+        S = self.num_shards
+        row, n_rows, row_len, width, total_edges = self._wire_mesh_plan(stream)
+        n_groups = -(-n_rows // S) if n_rows else 0
+        step, _ = self._wire_stream_fns(cfg, stream._stages, row_len, width)
+        every_groups = (
+            max(1, cfg.wire_checkpoint_batches // S)
+            if cfg.wire_checkpoint_batches
+            else 0
+        )
+        # mid-stream exchanges only happen at snapshot boundaries, so the
+        # delta buffers must be sized for the WHOLE stream when there is no
+        # checkpoint path — an interval-sized cap there would force spill
+        # retries and miss the dense-slab switch
+        interval_edges = (
+            (every_groups if checkpoint_path and every_groups else max(n_groups, 1))
+            * S
+            * row_len
+        )
+        ctx = self._shard_ctx(cfg, spec, interval_edges)
+        profile = spec.comm_profile(cfg, ctx)
+        exchange, gather = self._sharded_wire_fns(
+            cfg, spec, stream._stages, row_len, width, ctx
+        )
+        sharding = self._sharded_blocks_sharding()
+
+        start_group = 0
+        blocks = None
+        done_blocks = None
+        if checkpoint_path and restore and checkpoint_exists(checkpoint_path):
+            like = self._wire_sharded_checkpoint_like(stream, spec, row_len)
+            try:
+                snap = load_state(checkpoint_path, like)
+            except ValueError:
+                snap = None  # legacy/replicated/mismatched layout: fresh
+            if snap is not None:
+                if int(snap["row_len"]) != row_len or int(snap["shards"]) != S:
+                    raise ValueError(
+                        f"mesh wire checkpoint was written at row_len "
+                        f"{int(snap['row_len'])} x {int(snap['shards'])} "
+                        f"shards; resuming with {row_len} x {S} would "
+                        "misalign the stream position"
+                    )
+                if bool(snap["done"]):
+                    done_blocks = snap["blocks"]
+                else:
+                    start_group = int(snap["next_group"])
+                    blocks = jax.device_put(snap["blocks"], sharding)
+                    carry_stages = snap["stages"]
+        if done_blocks is not None:
+            # stream fully folded before the crash: re-emit from the blocks
+            # (at-least-once) without re-folding
+            metrics.comms_add("comms_bytes_gather", profile["gather_nbytes"])
+            out = agg.transform(gather(jax.device_put(done_blocks, sharding)))
+            yield out if isinstance(out, tuple) else (out,)
+            return
+        if blocks is None:
+            blocks = self._initial_blocks(spec, cfg)
+            carry_stages = None
+        like_carry = self._wire_mesh_checkpoint_like(stream, row_len)
+        carry = jax.device_put(
+            (
+                carry_stages if carry_stages is not None else like_carry["stages"],
+                like_carry["summary"],
+                like_carry["touched"],
+            ),
+            sharding,
+        )
+
+        def save(pos: int, done: bool, blocks_now, carry_now) -> None:
+            host_blocks = jax.tree.map(np.asarray, blocks_now)
+            host_stages = jax.tree.map(np.asarray, carry_now[0])
+            save_state(
+                checkpoint_path,
+                {
+                    "blocks": host_blocks,
+                    "stages": host_stages,
+                    "next_group": np.full((), pos, np.int64),
+                    "row_len": np.full((), row_len, np.int64),
+                    "shards": np.full((), S, np.int64),
+                    "done": np.full((), done, bool),
+                },
+            )
+
+        def prepare(g: int):
+            rows = np.empty((S, wire_mod.wire_nbytes(row_len, width)), np.uint8)
+            counts = np.zeros((S,), np.int32)
+            for s in range(S):
+                i = g * S + s
+                if i < n_rows:
+                    rows[s], counts[s] = row(i)
+                else:
+                    rows[s], _ = self._pack_padded_row(
+                        np.empty((0,), np.int32),
+                        np.empty((0,), np.int32),
+                        row_len,
+                        width,
+                    )
+            return g, (rows, counts)
+
+        since_snap = 0
+        with wire_mod.Prefetcher(
+            range(start_group, n_groups),
+            prepare,
+            device=sharding,
+            depth=cfg.prefetch_depth,
+        ) as pf:
+            for g, dev in pf:
+                rows_d, counts_d = dev
+                carry = step(carry, rows_d, counts_d)
+                metrics.comms_add("comms_dispatches", 1)
+                since_snap += 1
+                if checkpoint_path and every_groups and since_snap >= every_groups:
+                    # exchange at the snapshot boundary: local partials fold
+                    # into the owner blocks (delta buffers), scratch resets
+                    carry, blocks, stats = exchange(carry, blocks)
+                    self._record_exchange_stats(profile, stats)
+                    save(g + 1, False, blocks, carry)
+                    since_snap = 0
+        if total_edges == 0:
+            return
+        carry, blocks, stats = exchange(carry, blocks)
+        self._record_exchange_stats(profile, stats)
+        metrics.comms_add("comms_bytes_gather", profile["gather_nbytes"])
+        out = agg.transform(gather(blocks))
+        # emit BEFORE the final snapshot (at-least-once emission)
+        yield out if isinstance(out, tuple) else (out,)
+        if checkpoint_path:
+            save(n_groups, True, blocks, carry)
+
     def wire_records(
         self,
         stream,
@@ -1695,6 +2044,15 @@ class MeshAggregationRunner:
         )
 
         cfg = stream.cfg
+        spec = self._sharded_spec(cfg)
+        if spec is not None:
+            # the default: owner-sharded O(C/S) summary blocks with
+            # delta-compressed reconciliation at snapshot/stream-end
+            # boundaries (core/sharded_state.py)
+            yield from self._wire_records_sharded(
+                stream, spec, checkpoint_path, restore
+            )
+            return
         agg = self.agg
         S = self.num_shards
         multi = jax.process_count() > 1
@@ -1837,8 +2195,10 @@ class MeshAggregationRunner:
         return self.agg._restored_position(cfg, checkpoint_path, restore)
 
     def _pane_cap(self, total: int) -> int:
+        from gelly_streaming_tpu.parallel.routing import pow2_bucket
+
         per = -(-max(total, 1) // self.num_shards)  # ceil, >= 1
-        return max(1, 1 << (per - 1).bit_length())  # bounded set of shapes
+        return pow2_bucket(per)  # the shared shape-bucketing rule
 
     def _bucket_pane(self, pane: WindowPane):
         """Round-robin the pane's edges into [n_shards, cap] host arrays."""
@@ -1903,6 +2263,233 @@ class MeshAggregationRunner:
             counts[shard] = k
         return rows, counts, cap
 
+    def _pane_step_sharded(self, cfg: StreamConfig, spec, cap: int, kind, ctx):
+        """Compiled sharded pane fold: route -> fold -> exchange -> gather in
+        ONE dispatch against the persistent owner blocks.
+
+        ``kind`` is ("wire", width) for packed value-less rows or
+        ("raw", has_val) for bucket arrays.  The local fold runs the
+        descriptor's ordinary updateFun on a transient scratch; the spec's
+        delta exchange reconciles it into the O(C/S) blocks; the replicated
+        summary comes out of the emit-boundary gather — there is no
+        all_gather of full per-shard partials anywhere on this plane.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from gelly_streaming_tpu.io import wire
+        from gelly_streaming_tpu.parallel.mesh import shard_map
+
+        agg = self.agg
+        spec_p = P(self._axis)
+
+        def strip(t):
+            return jax.tree.map(lambda a: a[0], t)
+
+        def lift(t):
+            return jax.tree.map(lambda a: a[None], t)
+
+        def tail(blocks, src, dst, val, mask):
+            local = agg.update(agg.initial_state(cfg), src, dst, val, mask)
+            blocks2, stats = spec.exchange(local, strip(blocks), ctx)
+            summary = spec.gather_state(blocks2, ctx)  # gather-ok: emit — every pane close is an emission boundary on the windowed plane
+            stats_row = jnp.stack(
+                [stats.rounds, stats.delta_hwm, stats.spilled]
+            ).astype(jnp.int32)
+            return lift(blocks2), summary, stats_row[None]
+
+        if kind[0] == "wire":
+            width = kind[1]
+
+            def make():
+                def step(blocks, rows, counts):
+                    src, dst = wire.unpack_edges(rows[0], cap, width)
+                    mask = jnp.arange(cap, dtype=jnp.int32) < counts[0]
+                    return tail(blocks, src, dst, None, mask)
+
+                return shard_map(
+                    step,
+                    mesh=self.mesh,
+                    in_specs=(spec_p, spec_p, spec_p),
+                    out_specs=(spec_p, P(), spec_p),
+                )
+
+            key_tail = (cap, str(width), ctx.delta_cap, "wire")
+        else:
+            has_val = kind[1]
+
+            def make():
+                def step(blocks, src, dst, val, mask):
+                    return tail(
+                        blocks,
+                        src[0],
+                        dst[0],
+                        None if val is None else jax.tree.map(lambda a: a[0], val),
+                        mask[0],
+                    )
+
+                val_spec = spec_p if has_val else None
+
+                return shard_map(
+                    step,
+                    mesh=self.mesh,
+                    in_specs=(spec_p, spec_p, spec_p, val_spec, spec_p),
+                    out_specs=(spec_p, P(), spec_p),
+                )
+
+            key_tail = (cap, has_val, ctx.delta_cap, "raw")
+
+        return compile_cache.cached_jit(
+            ("mesh_sharded_pane",) + self._sharded_key(spec, cfg, *key_tail),
+            make,
+        )
+
+    def _run_sharded(
+        self,
+        stream,
+        spec,
+        window_ms: int,
+        checkpoint_path: Optional[str],
+        restore: bool,
+        panes: Optional[Callable],
+    ) -> OutputStream:
+        """Windowed mesh plane over owner-sharded summary state.
+
+        The persistent cross-window state is the O(C/S) block set; each
+        closed pane is routed on the prefetcher's pack thread (host keyBy
+        when the spec asks for it — ``spec.route_key`` — else the skew-free
+        round-robin), folded + delta-exchanged + lazily gathered in one
+        dispatch, and the gathered running summary rides the shared Merger
+        loop (``fold_is_running``) so emission order, at-least-once
+        semantics, and positional checkpoints are identical to the
+        replicated plane — which stays available as the equivalence oracle
+        (cfg.sharded_state=0).
+        """
+        from gelly_streaming_tpu.io import wire as wire_mod
+        from gelly_streaming_tpu.parallel.routing import host_route
+        from gelly_streaming_tpu.utils import metrics
+
+        cfg = stream.cfg
+        agg = self.agg
+        S = self.num_shards
+        width = agg._wire_width(cfg)
+        skip_through, skip_global = self._restored_position(
+            cfg, checkpoint_path, restore
+        )
+
+        def prepare(pane: WindowPane):
+            """Pack-thread routing + packing (keyBy off the dispatch thread):
+            value-less panes become packed per-shard wire rows — owner
+            buckets under ``spec.route_key``, round-robin otherwise — and
+            valued panes ship raw bucket arrays."""
+            already = (0 <= pane.window_id <= skip_through) or (
+                pane.window_id == -1 and skip_global
+            )
+            if already or len(pane.src) == 0:
+                return (pane, None, None), None
+            if pane.val is None:
+                if spec.route_key:
+                    routed = host_route(
+                        pane.src.astype(np.int32),
+                        pane.dst.astype(np.int32),
+                        S,
+                        key=spec.route_key,
+                    )
+                    counts = routed.mask.sum(axis=1).astype(np.int32)
+                    rows = wire_mod.pack_bucket_rows(
+                        routed.src, routed.dst, counts, width
+                    )
+                    return (pane, ("wire", width), routed.src.shape[1]), (
+                        rows,
+                        counts,
+                    )
+                rows, counts, cap = self._pack_pane_wire(pane, width)
+                return (pane, ("wire", width), cap), (rows, counts)
+            if spec.route_key:
+                routed = host_route(
+                    pane.src.astype(np.int32),
+                    pane.dst.astype(np.int32),
+                    S,
+                    key=spec.route_key,
+                    val=pane.val,
+                )
+                return (pane, ("raw", True), routed.src.shape[1]), (
+                    routed.src,
+                    routed.dst,
+                    routed.val,
+                    routed.mask,
+                )
+            src, dst, val, mask = self._bucket_pane(pane)
+            return (pane, ("raw", val is not None), src.shape[1]), (
+                src,
+                dst,
+                val,
+                mask,
+            )
+
+        def records() -> Iterator[tuple]:
+            import collections as _collections
+
+            sharding = self._sharded_blocks_sharding()
+            restored = agg._restored_summary(cfg, checkpoint_path, restore)
+            if restored is not None:
+                blocks = jax.device_put(
+                    spec.shard_summary(restored, cfg, S), sharding
+                )
+            else:
+                blocks = self._initial_blocks(spec, cfg)
+            initial = blocks if agg.transient_state else None
+            pending_stats = _collections.deque()
+            profiles = {}
+
+            def drain_stats(limit: int) -> None:
+                while len(pending_stats) > limit:
+                    stats, profile = pending_stats.popleft()
+                    self._record_exchange_stats(profile, stats)
+
+            def fold_prepared(item):
+                nonlocal blocks
+                (pane, kind, cap), dev = item
+                if kind is None:
+                    return None
+                ctx = self._shard_ctx(cfg, spec, S * cap)
+                profile = profiles.get(ctx.delta_cap)
+                if profile is None:
+                    profile = profiles[ctx.delta_cap] = spec.comm_profile(cfg, ctx)
+                if initial is not None:
+                    blocks = initial  # transient descriptors reset per window
+                step = self._pane_step_sharded(cfg, spec, cap, kind, ctx)
+                blocks, summary, stats = step(blocks, *dev)
+                metrics.comms_add("comms_dispatches", 1)
+                metrics.comms_add(
+                    "comms_bytes_gather", profile["gather_nbytes"]
+                )
+                # stats drain lags the pipeline depth so the async plane
+                # never blocks on a per-pane download
+                pending_stats.append((stats, profile))
+                drain_stats(max(2, cfg.prefetch_depth))
+                return summary
+
+            from gelly_streaming_tpu.core.windows import stream_panes as _sp
+
+            pane_iter = panes() if panes is not None else _sp(stream, window_ms)
+            try:
+                with wire_mod.Prefetcher(
+                    pane_iter, prepare, device=sharding, depth=cfg.prefetch_depth
+                ) as pf:
+                    yield from agg._merge_loop(
+                        cfg,
+                        ((meta[0], (meta, dev)) for meta, dev in pf),
+                        fold_prepared,
+                        checkpoint_path,
+                        restore,
+                        unwrap=True,
+                        fold_is_running=True,
+                    )
+            finally:
+                drain_stats(0)
+
+        return OutputStream(records)
+
     def run(
         self,
         stream,
@@ -1928,6 +2515,14 @@ class MeshAggregationRunner:
         cfg = stream.cfg
         window_ms = window_ms or self.agg.window_ms or cfg.window_ms
         agg = self.agg
+        spec = self._sharded_spec(cfg)
+        if spec is not None:
+            # the default windowed mesh plane: owner-sharded blocks +
+            # delta exchange + lazy emission gather (core/sharded_state.py);
+            # cfg.sharded_state=0 keeps the replicated oracle below
+            return self._run_sharded(
+                stream, spec, window_ms, checkpoint_path, restore, panes
+            )
         from gelly_streaming_tpu.io import wire as wire_mod
 
         # value-less panes honor the configured wire encoding exactly as the

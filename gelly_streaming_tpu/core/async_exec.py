@@ -238,11 +238,20 @@ def async_merge_loop(
     unwrap: bool = False,
     depth: int = 2,
     release: Optional[Callable] = None,
+    fold_is_running: bool = False,
 ) -> Iterator[tuple]:
     """The Merger with a non-blocking completion queue
     (SummaryAggregation._merge_loop's async form — same restore, merge,
     emission-order, and at-least-once semantics, pinned by
     tests/test_async_windows.py).
+
+    ``fold_is_running`` mirrors the synchronous loop: the owner-sharded
+    plane's folds accumulate into persistent cross-window blocks and return
+    the running summary directly, so no combine is dispatched here — the
+    double-buffered route -> fold -> exchange schedule stays non-blocking
+    (each pane's exchange+gather chains behind its fold in the device queue
+    while the NEXT pane's routing/packing runs on the prefetcher's pack
+    thread).
 
     Window folds dispatch without waiting; each window's emission record
     enters a completion queue with its device->host copies started, and
@@ -334,7 +343,7 @@ def async_merge_loop(
             pane_summary = fold_pane(payload)
             if pane_summary is None:
                 continue
-            if running is None or agg.transient_state:
+            if running is None or agg.transient_state or fold_is_running:
                 running = pane_summary
             else:
                 running = agg._combine_j(running, pane_summary)

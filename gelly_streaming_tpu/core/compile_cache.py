@@ -30,6 +30,17 @@ executables for all argument shapes.  The cache also meters itself:
 
 Counters are exposed through ``stats()`` here and re-exported by
 ``utils/metrics.py`` next to the throughput meters.
+
+Key discipline for MESH kernels (the owner-sharded summary plane): a
+``jax.sharding.Mesh`` object is not a guaranteed-stable identity across
+re-created runners, so sharded shard_map steps key on
+``parallel.mesh.mesh_cache_key(mesh)`` — device (platform, id) pairs plus
+axis names — alongside the descriptor's ``cache_token``, the frozen config,
+and every pow2-bucketed capacity the trace bakes in (pane cap, delta-buffer
+cap, wire width).  That puts the whole mesh plane under this cache's
+retrace guard: rebuilding a MeshAggregationRunner over the same devices
+resolves to the same executables, and ``recompiles()`` stays 0 across
+same-bucket panes (tests/test_sharded_state.py pins it).
 """
 
 from __future__ import annotations

@@ -88,6 +88,16 @@ class StreamConfig:
     # historical behavior and the equivalence oracle); when left at 0 the
     # GELLY_ASYNC_WINDOWS env var may switch it on process-wide.
     async_windows: int = 0
+    # Owner-sharded summary state on the mesh data plane
+    # (core/sharded_state.py): persistent per-shard summary state is an
+    # O(C/S) modulo block; cross-shard reconciliation exchanges pow2-bucketed
+    # delta buffers at emission/snapshot boundaries; the replicated view is
+    # gathered lazily only there.  1 = on, 0 = off (the all_gather-replicated
+    # combine, which remains the equivalence oracle), -1 = auto: the
+    # GELLY_SHARDED_STATE env var when set, else ON for descriptors that
+    # supply a ShardedStateSpec.  Descriptors without a spec always use the
+    # replicated combine regardless of this knob.
+    sharded_state: int = -1
     # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
     # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
     # values trail the watermark behind max seen time by the bound, holding
@@ -125,6 +135,8 @@ class StreamConfig:
             raise ValueError("ingest_workers must be >= 0")
         if self.async_windows < 0:
             raise ValueError("async_windows must be >= 0")
+        if self.sharded_state not in (-1, 0, 1):
+            raise ValueError("sharded_state must be -1 (auto), 0, or 1")
         if self.vertex_capacity <= 0:
             raise ValueError("vertex_capacity must be positive")
         if self.num_shards <= 0:

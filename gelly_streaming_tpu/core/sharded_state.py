@@ -1,0 +1,158 @@
+"""Owner-sharded summary state: the SummaryAggregation sharded-state protocol.
+
+The mesh runner's historical data plane keeps every shard's partial summary
+at FULL size and reconciles by all_gathering all S partials and re-combining
+them replicated on every shard — comms and combine cost O(C * S) per
+dispatch no matter how few labels a batch actually changed
+(core/aggregation.py, MeshAggregationRunner).  This module defines the
+protocol that replaces it as the default mesh streaming path (ISSUE 4):
+
+  * **Owner blocks** — the persistent summary is modulo block-sharded:
+    vertex g's row lives ONLY on shard g % S at block row g // S (same
+    ownership as parallel/mesh.owner_of, ring.py, BlockShardedCC).  Per-shard
+    persistent state — and checkpoint volume — is O(C/S).
+  * **Local folds** — a dispatch folds edges into a per-shard TRANSIENT
+    scratch with the descriptor's ordinary ``update`` (updateFun): no
+    collectives on the per-batch hot path.
+  * **Delta exchange** — cross-shard reconciliation ships fixed-capacity,
+    pow2-bucketed buffers of (changed row, value) pairs since the last
+    exchange (parallel/routing.pack_slab_deltas) via all_to_all —
+    propagation blocking (arXiv:2011.08451) + GraphBLAST's frontier/delta
+    formulation (arXiv:1908.01407): communicate only what changed, bucketed
+    by owning partition.  Exchanges happen at emission/snapshot boundaries,
+    so steady-state dispatches pay zero collective bytes.
+  * **Lazy gather** — the replicated full view is reassembled
+    (routing.gather_blocks) ONLY at emit/snapshot boundaries; the
+    collective-discipline analyzer pass (COLLGATHER) pins that confinement.
+
+Descriptors opt in by returning a ``ShardedStateSpec`` from
+``sharded_state_spec(cfg)``; the ``all_gather``-replicated combine remains
+the fallback — and the equivalence oracle — for descriptors that don't.
+
+Protocol contract: the block-sharded initial state must be the fold/combine
+identity (so empty shards and restores need no masking), and
+``combine(a, update(initial, e)) == update(a, e)`` must hold (running folds
+continue in place instead of re-merging per-pane partials) — true of the
+union-find and additive summaries this plane serves.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
+
+
+def resolve_sharded_state(cfg) -> bool:
+    """Effective sharded-state switch: config > env > on.
+
+    ``cfg.sharded_state``: 1 forces on, 0 forces off, -1 (default) defers to
+    the ``GELLY_SHARDED_STATE`` env var, defaulting ON — descriptors that
+    supply a spec ride the owner-sharded path unless explicitly disabled.
+    """
+    n = getattr(cfg, "sharded_state", -1)
+    if n in (0, 1):
+        return bool(n)
+    env = os.environ.get("GELLY_SHARDED_STATE")
+    if env is not None:
+        val = env.strip().lower()
+        if val in ("0", "false", "off", "no"):
+            return False
+        if val in ("1", "true", "on", "yes"):
+            return True
+        # an unrecognized spelling must not silently enable the plane the
+        # operator meant to switch: refuse loudly
+        raise ValueError(
+            f"GELLY_SHARDED_STATE={env!r} is not a recognized switch "
+            "(use 0/false/off/no or 1/true/on/yes)"
+        )
+    return True
+
+
+class ShardContext(NamedTuple):
+    """Static per-step facts handed to the spec's traced hooks."""
+
+    cfg: object
+    num_shards: int
+    axis_name: str = SHARD_AXIS
+    #: pow2-bucketed per-(sender, receiver) delta-buffer capacity
+    delta_cap: int = 1
+
+
+class ExchangeStats(NamedTuple):
+    """Device-side int32 counters an exchange returns (per shard).
+
+    Fetched at the exchange boundary (emit/snapshot — already a host sync
+    point) and folded into utils.metrics comms counters; never read on the
+    per-dispatch hot path.
+    """
+
+    rounds: object  # exchange passes executed (dynamic: spills/chains retry)
+    delta_hwm: object  # max per-owner changed-row demand seen (pre-capping)
+    spilled: object  # rows deferred past a full buffer (retried, never lost)
+
+
+class ShardedStateSpec:
+    """Descriptor hooks for the owner-sharded summary plane.
+
+    Subclasses implement the traced hooks against a single shard's view
+    (call them only inside shard_map over ``ctx.axis_name``).  The LOCAL
+    fold is deliberately NOT part of this spec: dispatches fold with the
+    descriptor's ordinary ``initial_state``/``update`` into a transient
+    full-[C] scratch, so the sharded and replicated planes share one
+    updateFun and cannot drift.
+    """
+
+    #: optional host_route key ("src"/"dst") — when set, the mesh runner's
+    #: pane prepare buckets edges by owner on the prefetcher's pack thread
+    #: (keyBy moved off the dispatch thread); None keeps round-robin panes
+    #: (skew-immune, e.g. CC's ring-free delta plane needs no edge routing)
+    route_key: Optional[str] = None
+
+    def __init__(self, agg):
+        self.agg = agg
+
+    # -- host-side hooks ------------------------------------------------------
+
+    def initial_shard_state(self, cfg, num_shards: int):
+        """[S, ...]-stacked host blocks (leading axis = shard) — MUST be the
+        combine identity so restores and empty shards need no masking."""
+        raise NotImplementedError
+
+    def shard_summary(self, summary, cfg, num_shards: int):
+        """Host: a replicated summary pytree -> [S, ...] owner blocks (the
+        inverse of ``gather_state``; used to seed blocks from a restored
+        positional checkpoint)."""
+        raise NotImplementedError
+
+    def delta_bound(self, cfg, n_edges: int) -> int:
+        """Rows that can change per exchange interval from ``n_edges`` folded
+        edges — sizes the pow2-bucketed delta buffers (routing.delta_capacity
+        clamps to C/S, the structural maximum)."""
+        return 2 * max(int(n_edges), 1)
+
+    def comm_profile(self, cfg, ctx: ShardContext) -> dict:
+        """Static per-shard byte costs: ``round_nbytes`` (one exchange pass)
+        and ``gather_nbytes`` (one full-view reassembly) — multiplied by the
+        dynamic round counts into utils.metrics comms counters."""
+        raise NotImplementedError
+
+    # -- traced hooks (inside shard_map) --------------------------------------
+
+    def exchange(self, local_state, blocks, ctx: ShardContext):
+        """Reconcile a local partial fold into the owner blocks.
+
+        ``local_state``: this shard's transient full-[C] partial (the
+        descriptor's ordinary summary pytree, folded since the LAST
+        exchange).  Returns ``(blocks', ExchangeStats)``; the caller resets
+        the local scratch to ``initial_state`` afterwards.  May loop
+        (while_loop + pmax) until every delta is absorbed — spilled buffer
+        rows re-derive next round rather than dropping.
+        """
+        raise NotImplementedError
+
+    def gather_state(self, blocks, ctx: ShardContext):
+        """Owner blocks -> the full replicated summary pytree (emit/snapshot
+        boundaries ONLY — the lazy gather the COLLGATHER pass sanctions)."""
+        raise NotImplementedError

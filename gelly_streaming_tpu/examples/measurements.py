@@ -741,7 +741,11 @@ def measure_routing(args) -> dict:
     if len(jax.devices()) < s_n:
         return {"skipped": f"need {s_n} devices, have {len(jax.devices())}"}
     per_shard = args.batch
-    cap = args.capacity
+    # the routers pow2-bucket their capacity (cache-stable shapes); report
+    # the EFFECTIVE per-pair capacity so drops describe the real experiment
+    from gelly_streaming_tpu.parallel.routing import pow2_bucket
+
+    cap = pow2_bucket(args.capacity)
     rng = np.random.default_rng(args.seed)
     # zipf keys clipped into the vertex space: a heavy head (hub vertices)
     # plus a long tail — the power-law shape that breaks plain keyBy

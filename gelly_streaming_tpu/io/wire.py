@@ -317,6 +317,35 @@ def pack_stream(
     return bufs, tail
 
 
+def pack_bucket_rows(
+    src2d: np.ndarray, dst2d: np.ndarray, counts: np.ndarray, width
+) -> np.ndarray:
+    """Pack per-shard edge buckets into wire rows: the mesh feed's keyBy form.
+
+    ``src2d``/``dst2d`` are [S, cap] host buckets (e.g. ``routing.host_route``
+    output, produced on the prefetcher's pack thread) with ``counts[s]``
+    valid edges per row.  Returns ``uint8[S, wire_nbytes(cap, width)]`` rows
+    whose pad region obeys the count-prefix contract of the sharded device
+    steps: fixed-width encodings keep position (zero pads are fine), EF40
+    sorts — pads are rewritten to the maximal id pair so they sort to the
+    END and a count prefix selects exactly the real edges (the same
+    invariant as ``MeshAggregationRunner._pack_pane_wire``).
+    """
+    n_rows, cap = src2d.shape
+    rows = np.zeros((n_rows, wire_nbytes(cap, width)), np.uint8)
+    pad_id = width[1] - 1 if isinstance(width, tuple) else 0
+    s = np.empty((cap,), np.int32)
+    d = np.empty((cap,), np.int32)
+    for r in range(n_rows):
+        k = int(counts[r])
+        s[:k] = src2d[r, :k]
+        d[:k] = dst2d[r, :k]
+        s[k:] = pad_id
+        d[k:] = pad_id
+        pack_edges_into(s, d, width, rows[r])
+    return rows
+
+
 def unpack_edges_host(buf: np.ndarray, n: int, width):
     """Host-side (numpy) decode of one wire buffer -> (src, dst) int32[n].
 

@@ -29,6 +29,7 @@ from gelly_streaming_tpu.core.aggregation import (
 )
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
+from gelly_streaming_tpu.core.sharded_state import ShardedStateSpec
 from gelly_streaming_tpu.ops import unionfind as uf
 from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
 from gelly_streaming_tpu.summaries.disjoint_set import DisjointSet
@@ -93,6 +94,11 @@ class _CCMixin:
 
         return combine
 
+    def sharded_state_spec(self, cfg: StreamConfig):
+        """Owner-sharded summary state (ISSUE 4): O(C/S) label/seen blocks
+        per shard, root-delta exchanges, lazy emission gather."""
+        return CCShardedState(self)
+
 
 class ConnectedComponents(_CCMixin, SummaryBulkAggregation):
     """Flat-combine streaming CC (library/ConnectedComponents.java:41-56)."""
@@ -100,6 +106,239 @@ class ConnectedComponents(_CCMixin, SummaryBulkAggregation):
 
 class ConnectedComponentsTree(_CCMixin, SummaryTreeAggregation):
     """Tree-combine streaming CC (library/ConnectedComponentsTree.java:26-36)."""
+
+
+# ---------------------------------------------------------------------------
+# Owner-sharded summary state (core/sharded_state.py protocol)
+# ---------------------------------------------------------------------------
+
+
+class CCBlocks(NamedTuple):
+    """One shard's owner block of the CC summary: O(C/S) rows."""
+
+    label: jax.Array  # int32[C/S] — parent-pointer forest rows this shard owns
+    seen: jax.Array  # bool[C/S]
+
+
+class CCShardedState(ShardedStateSpec):
+    """Block-sharded streaming CC state with root-delta reconciliation.
+
+    Persistent state per shard is vertex g's forest row at (g % S, g // S) —
+    the quadrant-B BlockShardedCC ownership, generalized behind the
+    SummaryAggregation protocol.  Edges fold locally (arrival placement,
+    ring-free and skew-immune — no keyBy shuffle); reconciliation exchanges
+    ONLY the remapped OLD-ROOT rows since the last exchange:
+
+      per round: gather the block forest (the one sanctioned full-view
+      collective per round), compress, apply the local partial's constraints
+      as union edges, and ship (old root -> new min) pairs to their owners
+      through fixed-capacity pow2-bucketed delta buffers
+      (routing.exchange_slab_deltas) — min-folded into the owner rows.
+
+    Hooking OLD ROOT ROWS ONLY keeps every non-root pointer chain intact
+    (the Shiloach-Vishkin discipline ``block_sharded_cc_round`` documents),
+    so the delta is bounded by merges-since-last-exchange, not component
+    sizes, and capacity spills simply re-derive next round (the spilled row
+    still reads as a remapped root against the re-gathered forest).  The
+    loop ends when no old root remaps anywhere (pmax) — at that point every
+    local constraint is intra-component in the block forest, the same fixed
+    point as the replicated combine, so the gathered emission is
+    bit-identical to the oracle's min labels.
+    """
+
+    route_key = None  # edges stay where they arrive: labels travel, not edges
+
+    # -- host-side hooks ------------------------------------------------------
+
+    def initial_shard_state(self, cfg, num_shards: int):
+        from gelly_streaming_tpu.parallel.mesh import block_rows
+
+        return CCBlocks(
+            label=init_label_blocks(cfg.vertex_capacity, num_shards),
+            seen=np.zeros(
+                (num_shards, block_rows(cfg.vertex_capacity, num_shards)), bool
+            ),
+        )
+
+    def shard_summary(self, summary, cfg, num_shards: int):
+        """CCState([C], [C]) -> [S, C/S] blocks (restore seeding)."""
+        parent = np.asarray(summary["parent"] if isinstance(summary, dict) else summary.parent)
+        seen = np.asarray(summary["seen"] if isinstance(summary, dict) else summary.seen)
+        return CCBlocks(
+            label=np.ascontiguousarray(parent.reshape(-1, num_shards).T),
+            seen=np.ascontiguousarray(seen.reshape(-1, num_shards).T),
+        )
+
+    def delta_bound(self, cfg, n_edges: int) -> int:
+        # one merge (one remapped root) per union; both endpoints' seen rows
+        return 2 * max(int(n_edges), 1)
+
+    @staticmethod
+    def _dense(cfg, ctx) -> bool:
+        """True when the exchange interval can touch most of the state.
+
+        The delta buffers only compress when the changed set is genuinely
+        smaller than the state: once ``delta_capacity`` clamps at the
+        structural C/S maximum, packed (row, value) buffers cost MORE than
+        shipping whole slabs, and the root-delta formulation converges in
+        more rounds than full-slab min propagation — so the saturated
+        regime exchanges dense slabs (still O(C) per shard per round, 1/S
+        of the replicated plane's O(C*S)), and the incremental regime
+        (windowed panes, frequent snapshots) rides the delta buffers.
+        """
+        return ctx.delta_cap >= cfg.vertex_capacity // ctx.num_shards
+
+    def comm_profile(self, cfg, ctx) -> dict:
+        from gelly_streaming_tpu.parallel import routing
+
+        c = cfg.vertex_capacity
+        if self._dense(cfg, ctx):
+            # per round: label-block gather + full-slab proposal swap;
+            # emission adds the label + seen reassembly + one seen slab swap
+            return {
+                "round_nbytes": 2 * routing.gather_blocks_nbytes(c, 4),
+                "gather_nbytes": routing.gather_blocks_nbytes(c, 4)
+                + 2 * routing.gather_blocks_nbytes(c, 1),
+            }
+        return {
+            # per exchange round: label-block gather + one delta buffer swap
+            "round_nbytes": routing.gather_blocks_nbytes(c, 4)
+            + routing.delta_exchange_nbytes(ctx.num_shards, ctx.delta_cap, 4),
+            # per emission/snapshot: label + seen full-view reassembly, plus
+            # the one-shot seen delta swap
+            "gather_nbytes": routing.gather_blocks_nbytes(c, 4)
+            + routing.gather_blocks_nbytes(c, 1)
+            + routing.delta_exchange_nbytes(ctx.num_shards, ctx.delta_cap, 4),
+        }
+
+    # -- traced hooks (inside shard_map) --------------------------------------
+
+    def _exchange_dense(self, local_state, blocks, ctx):
+        """Saturated-regime exchange: full-slab min propagation.
+
+        Per round every shard merges its local constraints into the
+        gathered forest and proposes whole per-owner slabs; owners keep the
+        elementwise min.  Each proposal array is a total compressed closure,
+        so one shard's proposal can never fragment a component, and
+        cross-shard disagreements reconverge through the next round's
+        re-derived closures (the validated proto of the ISSUE-4 plane) —
+        fewer rounds than root-deltas when nearly every row changed.
+        """
+        from gelly_streaming_tpu.core.sharded_state import ExchangeStats
+        from gelly_streaming_tpu.parallel import routing
+
+        n, axis = ctx.num_shards, ctx.axis_name
+        v = jnp.arange(local_state.parent.shape[0], dtype=jnp.int32)
+        local_p = local_state.parent
+        zero = jnp.zeros((), jnp.int32)
+
+        def cond(c):
+            return c[1]
+
+        def body(c):
+            blk, _, rounds, hwm = c
+            full = routing.gather_blocks(blk, n, axis)  # gather-ok: exchange reconciliation round (emit/snapshot boundary)
+            p2 = uf.union_edges(full, v, local_p)
+            occ = jnp.max(
+                jnp.sum((p2 != full).reshape(-1, n).astype(jnp.int32), axis=0)
+            )
+            recv = routing.slab_exchange(p2, n, axis)
+            blk2 = jnp.minimum(blk, jnp.min(recv, axis=0))
+            again = jax.lax.pmax(jnp.any(blk2 != blk), axis)
+            return blk2, again, rounds + 1, jnp.maximum(hwm, occ)
+
+        label, _, rounds, hwm = jax.lax.while_loop(
+            cond, body, (blocks.label, jnp.asarray(True), zero, zero)
+        )
+        seen_recv = routing.slab_exchange(
+            local_state.seen.astype(jnp.int32), n, axis
+        )
+        seen_blk = blocks.seen | jnp.any(seen_recv.astype(bool), axis=0)
+        return CCBlocks(label=label, seen=seen_blk), ExchangeStats(
+            rounds=rounds, delta_hwm=hwm, spilled=zero
+        )
+
+    def exchange(self, local_state, blocks, ctx):
+        from gelly_streaming_tpu.core.sharded_state import ExchangeStats
+        from gelly_streaming_tpu.parallel import routing
+
+        if self._dense(ctx.cfg, ctx):
+            return self._exchange_dense(local_state, blocks, ctx)
+        n, axis, cap = ctx.num_shards, ctx.axis_name, ctx.delta_cap
+        v = jnp.arange(local_state.parent.shape[0], dtype=jnp.int32)
+        local_p = local_state.parent
+
+        def cond(c):
+            return c[1]
+
+        def body(c):
+            blk, _, rounds, hwm, spills = c
+            full = routing.gather_blocks(blk, n, axis)  # gather-ok: exchange reconciliation round (emit/snapshot boundary)
+            base = uf.compress(full)
+            p2 = uf.union_edges(base, v, local_p)
+            # the delta: OLD ROOT rows that remapped — bounded by merges
+            # since the last exchange, never by component sizes
+            changed = (base == v) & (p2 != v)
+            recv_rows, recv_vals, _, occ, sp = routing.exchange_slab_deltas(
+                changed, p2, n, cap, axis, fill=jnp.iinfo(jnp.int32).max
+            )
+            blk2 = routing.apply_block_deltas(
+                blk, recv_rows, recv_vals, "min", jnp.iinfo(jnp.int32).max
+            )
+            again = jax.lax.pmax(jnp.any(changed), axis)
+            return (
+                blk2,
+                again,
+                rounds + 1,
+                jnp.maximum(hwm, occ),
+                spills + sp,
+            )
+
+        zero = jnp.zeros((), jnp.int32)
+        label, _, rounds, hwm, spills = jax.lax.while_loop(
+            cond, body, (blocks.label, jnp.asarray(True), zero, zero, zero)
+        )
+
+        # seen: one retried delta pass (op=max == or); rows are distinct, so
+        # per-owner demand <= min(C/S, touched) and spills only defer
+        seen_full = routing.gather_blocks(blocks.seen, n, axis)  # gather-ok: exchange reconciliation round (emit/snapshot boundary)
+
+        def seen_cond(c):
+            return jax.lax.pmax(jnp.any(c[1]), axis)
+
+        def seen_body(c):
+            sb, pending, rounds2, hwm2 = c
+            recv_rows, recv_vals, sent, occ, _ = routing.exchange_slab_deltas(
+                pending, pending.astype(jnp.int32), n, cap, axis, fill=0
+            )
+            sb2 = routing.apply_block_deltas(
+                sb.astype(jnp.int32), recv_rows, recv_vals, "max", 0
+            ).astype(bool)
+            return sb2, pending & ~sent, rounds2 + 1, jnp.maximum(hwm2, occ)
+
+        seen_blk, _, _seen_rounds, seen_hwm = jax.lax.while_loop(
+            seen_cond,
+            seen_body,
+            (blocks.seen, local_state.seen & ~seen_full, zero, zero),
+        )
+        # rounds meters LABEL rounds only: comm accounting multiplies it by
+        # round_nbytes (gather + delta swap), which seen passes don't pay —
+        # their single expected swap is in gather_nbytes, and spill retries
+        # beyond it are rare enough that bytes stay a tight lower bound
+        stats = ExchangeStats(
+            rounds=rounds,
+            delta_hwm=jnp.maximum(hwm, seen_hwm),
+            spilled=spills,
+        )
+        return CCBlocks(label=label, seen=seen_blk), stats
+
+    def gather_state(self, blocks, ctx):
+        from gelly_streaming_tpu.parallel import routing
+
+        full = routing.gather_blocks(blocks.label, ctx.num_shards, ctx.axis_name)  # gather-ok: emit — lazy replicated view at emission/snapshot boundaries
+        seen = routing.gather_blocks(blocks.seen, ctx.num_shards, ctx.axis_name)  # gather-ok: emit — lazy replicated view at emission/snapshot boundaries
+        # fully compress so the emitted labels are the oracle's min labels
+        return CCState(parent=uf.compress(full), seen=seen)
 
 
 # ---------------------------------------------------------------------------

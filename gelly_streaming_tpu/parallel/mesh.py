@@ -33,6 +33,32 @@ def owner_of(vertex_ids: np.ndarray, num_shards: int) -> np.ndarray:
     return vertex_ids % num_shards
 
 
+def mesh_cache_key(mesh: Mesh):
+    """A process-stable hashable identity for a mesh, for executable-cache keys.
+
+    A ``Mesh`` object itself hashes by identity semantics that are not
+    guaranteed stable across re-created meshes on every jax version, so
+    kernels compiled per mesh key on the raw object could silently retrace
+    when a runner is rebuilt.  Device ids + platform + axis names ARE stable
+    for the same topology within a process, so two ``make_mesh(n)`` calls
+    resolve to the same executables (core/compile_cache.py keys the
+    mesh-runner sharded steps on this).
+    """
+    return (
+        tuple((d.platform, d.id) for d in mesh.devices.flat),
+        tuple(mesh.axis_names),
+    )
+
+
+def block_rows(capacity: int, num_shards: int) -> int:
+    """Rows of one owner block of a [capacity] modulo-sharded state."""
+    if capacity % num_shards:
+        raise ValueError(
+            f"vertex capacity {capacity} must divide over {num_shards} shards"
+        )
+    return capacity // num_shards
+
+
 try:  # jax >= 0.5 exports shard_map at top level; older builds under
     # jax.experimental (accessing the missing top-level name raises
     # AttributeError from jax's deprecation shim)

@@ -10,6 +10,17 @@ SummaryBulkAggregation.java:78).  Here routing happens in two places:
     analog; SURVEY.md §5.8 "control/ingest plane").
   * device_route: the data plane — re-keying mid-pipeline without leaving the
     mesh, via in-shard bucketing + ``lax.all_to_all`` over ICI.
+  * the delta-exchange plane (owner-sharded summary state, ISSUE 4): modulo
+    block-sharded per-vertex state reconciles across shards by exchanging
+    FIXED-CAPACITY buffers of (changed row, value) pairs — pow2-bucketed so
+    shapes stay cache-stable — instead of all_gathering the full state
+    (propagation blocking, arXiv:2011.08451; GraphBLAST's frontier/delta
+    formulation, arXiv:1908.01407).  ``gather_blocks`` is the sanctioned
+    full-view reassembly for emit/snapshot boundaries.
+
+All capacities are pow2-bucketed (``pow2_bucket``): a pane whose occupancy
+varies window to window still resolves to one of log2(C) compiled shapes, so
+the executable cache never retraces on the sharded path.
 """
 
 from __future__ import annotations
@@ -22,6 +33,12 @@ import numpy as np
 
 from gelly_streaming_tpu.ops import segments
 from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the shared shape-bucketing rule
+    (same policy as stream.plan_superbatch_groups / the pane fold pads)."""
+    return 1 << (max(int(n), 1) - 1).bit_length()
 
 
 class RoutedEdges(NamedTuple):
@@ -61,8 +78,8 @@ def host_route(
 
         lib = load_ingest_lib()
         if lib is not None and hasattr(lib, "route_edges"):
-            cap = capacity or max(
-                1, int(np.bincount(
+            cap = capacity or pow2_bucket(
+                int(np.bincount(
                     (src if key == "src" else dst) % num_shards,
                     minlength=num_shards,
                 ).max())
@@ -90,7 +107,10 @@ def host_route(
                 return RoutedEdges(s, d, m, None)
     owner = (src if key == "src" else dst) % num_shards
     counts = np.bincount(owner, minlength=num_shards)
-    cap = capacity or (int(counts.max()) if len(src) else 1)
+    # auto capacity pow2-buckets (explicit capacities are honored as given):
+    # varying pane occupancy across windows resolves to a handful of shapes,
+    # so downstream compiled steps reuse cached executables (retrace guard)
+    cap = capacity or (pow2_bucket(int(counts.max())) if len(src) else 1)
     s = np.zeros((num_shards, cap), np.int32)
     d = np.zeros((num_shards, cap), np.int32)
     m = np.zeros((num_shards, cap), bool)
@@ -115,6 +135,20 @@ def host_route(
     return RoutedEdges(s, d, m, v)
 
 
+def owner_rank(owner: jax.Array, mask: jax.Array, num_shards: int) -> jax.Array:
+    """Per-owner occurrence rank for owner ids in [0, num_shards).
+
+    The generic ``segments.occurrence_rank`` argsorts the whole batch — an
+    XLA sort per routing call, ~10x the cost of the scatter it feeds on the
+    CPU backend.  Owners come from a tiny dense alphabet, so a one-hot
+    cumsum computes the same rank in one O(n * S) elementwise pass.
+    """
+    oh = (owner[:, None] == jnp.arange(num_shards, dtype=owner.dtype)[None, :])
+    oh = oh & mask[:, None]
+    c = jnp.cumsum(oh.astype(jnp.int32), axis=0)
+    return c[jnp.arange(owner.shape[0]), owner] - 1
+
+
 def device_route(
     src: jax.Array,
     dst: jax.Array,
@@ -123,49 +157,95 @@ def device_route(
     capacity: int,
     key: str = "src",
     axis_name: str = SHARD_AXIS,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    val=None,
+) -> "RoutedDeviceEdges":
     """Re-key this shard's edges to their owner shards (call inside shard_map).
 
     Buckets local edges into a [S, cap] send buffer (scatter by per-owner
     occurrence rank), then ``all_to_all`` swaps buffers so each shard receives
-    the edges it owns.  Overflow beyond ``cap`` per (sender, receiver) pair is
-    dropped and COUNTED: the last return value is this shard's scalar dropped
-    count — never silent.  Size cap for the worst expected skew, check the
-    counter, or use ``device_route_salted`` for power-law keys (SURVEY.md §7).
+    the edges it owns.  ``capacity`` is pow2-bucketed (``pow2_bucket``) so
+    varying occupancy reuses cached executables.  An optional ``val`` pytree
+    of per-edge payloads routes alongside the ids (the keyed-record analog of
+    host_route's val).  Overflow beyond the bucketed cap per
+    (sender, receiver) pair is dropped and COUNTED: ``dropped`` is this
+    shard's scalar dropped count — never silent.  Size cap for the worst
+    expected skew, check the counter, or use ``device_route_salted`` for
+    power-law keys (SURVEY.md §7).
 
-    Returns (src, dst, mask, dropped) with edges flattened to [S * cap].
+    Returns RoutedDeviceEdges(src, dst, mask, dropped, val) with edges
+    flattened to [S * bucketed_cap].
     """
     routing_key = src if key == "src" else dst
     owner = jnp.where(mask, routing_key % num_shards, num_shards - 1)
     return _exchange_by_owner(
-        src, dst, mask, owner, num_shards, capacity, axis_name
+        src, dst, mask, owner, num_shards, capacity, axis_name, val
     )
 
 
-def _exchange_by_owner(src, dst, mask, owner, num_shards, capacity, axis_name):
+class RoutedDeviceEdges:
+    """device_route result: flattened [S * cap] per-shard received edges.
+
+    Deliberately NOT a pytree (destructure it inside the traced caller —
+    returning it across a jit/shard_map boundary is an error): iterating
+    yields the legacy 4-tuple ``(src, dst, mask, dropped)`` so pre-val call
+    sites keep unpacking unchanged; ``.val`` carries the routed payload.
+    """
+
+    __slots__ = ("src", "dst", "mask", "dropped", "val")
+
+    def __init__(self, src, dst, mask, dropped, val=None):
+        self.src = src
+        self.dst = dst
+        self.mask = mask
+        self.dropped = dropped  # scalar int32: rows this shard failed to send
+        self.val = val  # routed payload pytree or None
+
+    def __iter__(self):
+        return iter((self.src, self.dst, self.mask, self.dropped))
+
+
+def _exchange_by_owner(
+    src, dst, mask, owner, num_shards, capacity, axis_name, val=None
+):
     """Scatter rows into [S, cap] send buffers by ``owner`` and all_to_all."""
-    rank = segments.occurrence_rank(owner, mask)
+    capacity = pow2_bucket(capacity)
+    rank = owner_rank(owner, mask, num_shards)
     ok = mask & (rank < capacity)
     dropped = jnp.sum((mask & ~ok).astype(jnp.int32))
     slot = jnp.where(ok, owner * capacity + rank, num_shards * capacity)
 
     def build(buf_fill, values):
-        buf = jnp.full((num_shards * capacity,), buf_fill, values.dtype)
-        return buf.at[slot].set(jnp.where(ok, values, buf_fill), mode="drop").reshape(
-            num_shards, capacity
+        flat_fill = jnp.asarray(buf_fill, values.dtype)
+        buf = jnp.full(
+            (num_shards * capacity,) + values.shape[1:], flat_fill, values.dtype
         )
+        return buf.at[slot].set(
+            jnp.where(
+                ok.reshape((-1,) + (1,) * (values.ndim - 1)), values, flat_fill
+            ),
+            mode="drop",
+        ).reshape((num_shards, capacity) + values.shape[1:])
 
-    send_src = build(0, src)
-    send_dst = build(0, dst)
-    send_mask = build(False, ok)
-    recv_src = jax.lax.all_to_all(send_src, axis_name, 0, 0, tiled=False)
-    recv_dst = jax.lax.all_to_all(send_dst, axis_name, 0, 0, tiled=False)
-    recv_mask = jax.lax.all_to_all(send_mask, axis_name, 0, 0, tiled=False)
-    return (
+    def swap(sent):
+        return jax.lax.all_to_all(sent, axis_name, 0, 0, tiled=False)
+
+    recv_src = swap(build(0, src))
+    recv_dst = swap(build(0, dst))
+    recv_mask = swap(build(False, ok))
+    recv_val = None
+    if val is not None:
+        recv_val = jax.tree.map(
+            lambda leaf: swap(build(0, leaf)).reshape(
+                (num_shards * capacity,) + leaf.shape[1:]
+            ),
+            val,
+        )
+    return RoutedDeviceEdges(
         recv_src.reshape(-1),
         recv_dst.reshape(-1),
         recv_mask.reshape(-1),
         dropped,
+        recv_val,
     )
 
 
@@ -177,7 +257,8 @@ def device_route_salted(
     capacity: int,
     key: str = "src",
     axis_name: str = SHARD_AXIS,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    val=None,
+) -> RoutedDeviceEdges:
     """Skew-safe routing for *associative* keyed aggregation: hot keys spread.
 
     The reference's keyBy sends every record of a key to one subtask — a
@@ -202,5 +283,181 @@ def device_route_salted(
     salt = segments.occurrence_rank(routing_key, mask)
     owner = (base_owner + salt) % num_shards
     return _exchange_by_owner(
-        src, dst, mask, owner, num_shards, capacity, axis_name
+        src, dst, mask, owner, num_shards, capacity, axis_name, val
     )
+
+
+# ---------------------------------------------------------------------------
+# Owner-sharded summary state: block exchange primitives (ISSUE 4).
+#
+# Per-vertex summary state lives modulo-block-sharded over the mesh: vertex g
+# is owned by shard g % S at block row g // S (the same ownership as
+# mesh.owner_of / ring.py / BlockShardedCC).  The primitives below move state
+# between the full [C] per-shard view (transient fold scratch) and the
+# persistent [C/S] owner blocks:
+#
+#   * slab_exchange    — dense: every shard sends owner o its [C/S] slab of a
+#                        full-[C] value array (one all_to_all; per-shard
+#                        volume C, vs the S*C of all_gathering S partials).
+#   * pack_slab_deltas — sparse: compact only CHANGED rows into fixed
+#                        [S, cap] (row, value) buffers; cap is pow2-bucketed
+#                        so shapes stay cache-stable, and the true demand is
+#                        returned as ``occupancy`` (the delta-occupancy
+#                        high-water metric) with spill counts — spilled rows
+#                        are simply retried by the caller's exchange loop,
+#                        never silently lost.
+#   * gather_blocks    — the sanctioned full-view reassembly for
+#                        emit/snapshot boundaries only (COLLGATHER pass).
+
+
+DELTA_PAD = -1  # pack_slab_deltas row sentinel for empty buffer slots
+
+
+def slab_exchange(values: jax.Array, num_shards: int, axis_name: str = SHARD_AXIS):
+    """Dense block route: full-[C] per-shard ``values`` -> [S, C/S] received.
+
+    Row o of the send view holds this shard's values for owner o's block
+    rows (``values[o + S*i]``); after the all_to_all, ``recv[s, i]`` is what
+    shard s proposed for MY block row i.  Per-shard traffic is C values —
+    1/S of the S*C an all_gather of S full partials ships.
+    """
+    slabs = values.reshape(-1, num_shards).T  # [S, C/S]
+    return jax.lax.all_to_all(slabs, axis_name, 0, 0, tiled=False)
+
+
+def slab_exchange_nbytes(capacity: int, itemsize: int = 4) -> int:
+    """Per-shard wire volume of one slab_exchange over a [C] value array."""
+    return capacity * itemsize
+
+
+def delta_capacity(capacity: int, num_shards: int, delta_bound: int) -> int:
+    """Pow2-bucketed per-(sender, receiver) capacity for a delta exchange.
+
+    Keys in a slab-delta buffer are DISTINCT block rows, so per-owner demand
+    is structurally <= C/S; ``delta_bound`` caps it further by how many rows
+    can have changed since the last exchange (e.g. 2 edges' endpoints per
+    fold).  The pow2 bucket keeps compiled shapes cache-stable while the
+    buffer stays O(min(C/S, delta)) instead of O(C).
+    """
+    from gelly_streaming_tpu.parallel.mesh import block_rows
+
+    return pow2_bucket(min(block_rows(capacity, num_shards), max(int(delta_bound), 1)))
+
+
+def pack_slab_deltas(
+    changed: jax.Array,
+    values: jax.Array,
+    num_shards: int,
+    capacity: int,
+    fill,
+):
+    """Compact changed rows of a full-[C] view into per-owner delta buffers.
+
+    ``changed``/``values`` are [C] by global id.  Returns
+    ``(rows [S, cap] int32, vals [S, cap], sent [C] bool, occupancy,
+    spilled)``: ``rows`` holds block-row indices (``g // S``; DELTA_PAD marks
+    empty slots), ``vals`` the proposed values (``fill`` on padding),
+    ``sent`` which changed rows made it into a buffer (retry loops clear
+    those and re-pack the rest), ``occupancy`` the max per-owner demand
+    BEFORE capping (the delta high-water mark — if it tops the capacity,
+    ``spilled`` counts the overflow rows, which the caller's exchange loop
+    re-derives next round).  Rank is a per-slab cumsum (the rows are already
+    owner-structured), so no sort is paid.
+    """
+    c2 = changed.reshape(-1, num_shards)  # [C/S, S]: column o = owner o rows
+    v2 = values.reshape(-1, num_shards)
+    rank = jnp.cumsum(c2.astype(jnp.int32), axis=0) - 1
+    counts = jnp.sum(c2, axis=0)
+    ok = c2 & (rank < capacity)
+    slot = jnp.where(
+        ok,
+        jnp.arange(num_shards, dtype=jnp.int32)[None, :] * capacity + rank,
+        num_shards * capacity,
+    )
+    block_row = jnp.broadcast_to(
+        jnp.arange(c2.shape[0], dtype=jnp.int32)[:, None], c2.shape
+    )
+    rows = (
+        jnp.full((num_shards * capacity,), DELTA_PAD, jnp.int32)
+        .at[slot.reshape(-1)]
+        .set(jnp.where(ok, block_row, DELTA_PAD).reshape(-1), mode="drop")
+        .reshape(num_shards, capacity)
+    )
+    fill = jnp.asarray(fill, v2.dtype)
+    vals = (
+        jnp.full((num_shards * capacity,), fill, v2.dtype)
+        .at[slot.reshape(-1)]
+        .set(jnp.where(ok, v2, fill).reshape(-1), mode="drop")
+        .reshape(num_shards, capacity)
+    )
+    occupancy = jnp.max(counts)
+    spilled = jnp.sum(jnp.maximum(counts - capacity, 0))
+    return rows, vals, ok.reshape(-1), occupancy, spilled
+
+
+def exchange_slab_deltas(
+    changed: jax.Array,
+    values: jax.Array,
+    num_shards: int,
+    capacity: int,
+    axis_name: str = SHARD_AXIS,
+    fill=0,
+):
+    """pack_slab_deltas + the all_to_all swap.
+
+    Returns ``(recv_rows [S, cap], recv_vals [S, cap], sent [C] bool,
+    occupancy, spilled)`` — ``recv_rows[s]`` are MY block rows shard s
+    proposes values for (DELTA_PAD = empty slot).  Apply with
+    ``apply_block_deltas``; retry loops clear ``sent`` rows and re-pack.
+    """
+    rows, vals, sent, occupancy, spilled = pack_slab_deltas(
+        changed, values, num_shards, capacity, fill
+    )
+    recv_rows = jax.lax.all_to_all(rows, axis_name, 0, 0, tiled=False)
+    recv_vals = jax.lax.all_to_all(vals, axis_name, 0, 0, tiled=False)
+    return recv_rows, recv_vals, sent, occupancy, spilled
+
+
+def delta_exchange_nbytes(num_shards: int, capacity: int, itemsize: int = 4) -> int:
+    """Per-shard wire volume of one exchange_slab_deltas pass (rows + vals)."""
+    return num_shards * capacity * (4 + itemsize)
+
+
+def apply_block_deltas(block, recv_rows, recv_vals, op: str, fill):
+    """Fold received delta buffers into this shard's [C/S] block.
+
+    ``op``: "min" / "max" / "add" — the only reconciliation folds the
+    owner-sharded descriptors need (CC hooks, seen marks, degree counts).
+    Padding slots carry ``fill`` (the op identity) and a DELTA_PAD row, so
+    they scatter out of range and drop.
+    """
+    rows = block.shape[0]
+    ri = recv_rows.reshape(-1)
+    rv = recv_vals.reshape(-1)
+    ok = ri != DELTA_PAD
+    idx = jnp.where(ok, ri, rows)
+    vals = jnp.where(ok, rv, jnp.asarray(fill, rv.dtype))
+    if op == "min":
+        return block.at[idx].min(vals, mode="drop")
+    if op == "max":
+        return block.at[idx].max(vals, mode="drop")
+    if op == "add":
+        return block.at[idx].add(vals, mode="drop")
+    raise ValueError(f"unknown block-delta op {op!r}")
+
+
+def gather_blocks(block: jax.Array, num_shards: int, axis_name: str = SHARD_AXIS):
+    """[C/S] owner blocks -> the full [C] replicated view (per shard).
+
+    THE full-state collective: per-shard volume is C values, which is why the
+    collective-discipline pass confines it to emit/snapshot boundaries (and
+    the exchange internals below) — streaming-step kernels reconcile through
+    the delta buffers above instead.
+    """
+    g = jax.lax.all_gather(block, axis_name)  # gather-ok: block reassembly primitive; call sites are COLLGATHER-gated
+    return jnp.swapaxes(g, 0, 1).reshape((-1,) + g.shape[2:])
+
+
+def gather_blocks_nbytes(capacity: int, itemsize: int = 4) -> int:
+    """Per-shard wire volume of one gather_blocks over a [C]-row state."""
+    return capacity * itemsize

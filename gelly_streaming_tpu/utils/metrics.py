@@ -160,6 +160,83 @@ def reset_pipeline_stats() -> None:
         _PIPELINE = _pipeline_zero()
 
 
+# ---------------------------------------------------------------------------
+# Mesh collective comms accounting (the owner-sharded summary plane, ISSUE 4).
+# Process-global like the pipeline counters: dispatches happen on the merge
+# loop / async dispatch threads while stats drain elsewhere.  Byte figures
+# combine static per-call buffer sizes (collective shapes are compile-time
+# constants) with the DYNAMIC round counts the exchange kernels report, so
+# they measure what actually crossed the mesh, not a one-shot estimate.
+
+
+_COMMS_LOCK = threading.Lock()
+
+
+def _comms_zero() -> dict:
+    return {
+        # device dispatches that fed the mesh data plane
+        "comms_dispatches": 0,
+        # bytes shipped by delta/slab exchange passes (all_to_all)
+        "comms_bytes_exchange": 0.0,
+        # bytes shipped reassembling the replicated view at emit/snapshot
+        # boundaries (gather_blocks).  Only the OWNER-SHARDED plane meters
+        # itself; replicated-fallback runs (sharded_state=0) leave every
+        # counter at zero — their per-dispatch all_gather volume is the
+        # S*C*itemsize/dispatch the sharded plane exists to remove.
+        "comms_bytes_gather": 0.0,
+        # exchange passes executed (dynamic: chains/spills retry)
+        "comms_exchange_rounds": 0,
+        # max per-owner changed-row demand seen before capping (sizes the
+        # pow2-bucketed delta buffers; > capacity means spill-retry rounds)
+        "comms_delta_occupancy_hwm": 0,
+        # delta rows deferred past a full buffer (retried, never dropped)
+        "comms_delta_spilled": 0,
+    }
+
+
+_COMMS = _comms_zero()  # guarded-by: _COMMS_LOCK
+
+
+def comms_add(key: str, amount: float) -> None:
+    """Accumulate a mesh-comms counter (thread-safe; hot-path cheap)."""
+    with _COMMS_LOCK:
+        _COMMS[key] += amount
+
+
+def comms_high_water(key: str, value: float) -> None:
+    """Raise a mesh-comms high-water mark to ``value`` if it is higher."""
+    with _COMMS_LOCK:
+        if value > _COMMS[key]:
+            _COMMS[key] = value
+
+
+def comms_stats() -> dict:
+    """Process-wide mesh collective counters: per-dispatch collective byte
+    volume (exchange vs gather), exchange round counts, and the
+    delta-occupancy high-water mark.  Reported by bench.py next to
+    ``pipeline_stats`` and by the multichip scaling sweep (quadrant D) as
+    bytes/edge — the measured evidence that sharded-path comms scale
+    O(C/S + delta) per dispatch rather than O(C * S)."""
+    with _COMMS_LOCK:
+        out = dict(_COMMS)
+    out["comms_bytes_total"] = round(
+        out["comms_bytes_exchange"] + out["comms_bytes_gather"], 1
+    )
+    out["comms_bytes_exchange"] = round(out["comms_bytes_exchange"], 1)
+    out["comms_bytes_gather"] = round(out["comms_bytes_gather"], 1)
+    n = max(out["comms_dispatches"], 1)
+    out["comms_bytes_per_dispatch"] = round(out["comms_bytes_total"] / n, 1)
+    return out
+
+
+def reset_comms_stats() -> None:
+    """Zero the mesh-comms counters (call before a measurement window,
+    read ``comms_stats`` after)."""
+    global _COMMS
+    with _COMMS_LOCK:
+        _COMMS = _comms_zero()
+
+
 def compile_cache_stats() -> dict:
     """Process-wide executable-cache counters (core/compile_cache.py):
     entry hits/misses, XLA compiles + compile wall time, steady-state

@@ -1,0 +1,28 @@
+"""Seeded bug: full-state gathers inside a streaming-step kernel.
+
+Expected findings: exactly three COLLGATHER (raw lax.all_gather, a
+jax.lax.all_gather of the whole partial summary, and an unsanctioned
+gather_blocks call).  Analyzer input only — never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gelly_streaming_tpu.parallel import routing
+
+
+def stream_step(carry, src, dst, mask, axis):
+    states, summary = carry
+    summary = summary.at[src].min(jnp.where(mask, dst, summary.shape[0]))
+    # per-dispatch reconciliation by gathering EVERY shard's full partial:
+    # the O(C*S) wall the owner-sharded plane removed
+    gathered = lax.all_gather(summary, axis)
+    merged = jnp.min(gathered, axis=0)
+    also = jax.lax.all_gather(states, axis)
+    return (also, merged)
+
+
+def peek_blocks(block, num_shards, axis):
+    # reassembling the replicated view mid-stream, not at an emit boundary
+    return routing.gather_blocks(block, num_shards, axis)

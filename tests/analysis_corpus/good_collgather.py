@@ -1,0 +1,31 @@
+"""Clean counterpart: delta-buffer reconciliation, gathers sanctioned.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gelly_streaming_tpu.parallel import routing
+
+
+def stream_step(block, changed, values, num_shards, axis, cap):
+    # cross-shard reconciliation ships only the changed rows, pow2-bucketed
+    recv_rows, recv_vals, sent, occ, spilled = routing.exchange_slab_deltas(
+        changed, values, num_shards, cap, axis
+    )
+    return routing.apply_block_deltas(block, recv_rows, recv_vals, "min", 0)
+
+
+def emit_summary(block, num_shards, axis):
+    full = routing.gather_blocks(block, num_shards, axis)  # gather-ok: emit boundary — replicated view for the emitted record
+    return jnp.min(full)
+
+
+def snapshot_seen(seen, axis):
+    gathered = lax.all_gather(seen, axis)  # gather-ok: snapshot boundary download
+    extra = jax.lax.all_gather(  # gather-ok: emit — marker honored on the attribute's line
+        seen, axis
+    )
+    return gathered, extra

@@ -110,6 +110,7 @@ def test_cli_list_passes_names_all_five():
         "donation-safety",
         "lock-discipline",
         "trace-safety",
+        "collective-discipline",
     ):
         assert name in proc.stdout
 
@@ -154,6 +155,29 @@ def test_corpus_hotsync():
     # multi-line call — the satellite regression for hot_loop_lint's
     # original single-line marker scan
     assert _analyze("good_hotsync.py") == []
+
+
+def test_corpus_collgather():
+    findings = _analyze("bad_collgather.py")
+    assert _codes(findings) == ["COLLGATHER", "COLLGATHER", "COLLGATHER"]
+    assert any("all_gather" in f.message for f in findings)
+    assert any("gather_blocks" in f.message for f in findings)
+    # the good twin sanctions each gather with `# gather-ok: <why>`
+    # (including one marker hung on the attribute line of a wrapped call)
+    assert _analyze("good_collgather.py") == []
+
+
+def test_collgather_requires_a_reason():
+    # a bare `# gather-ok` without a why does NOT sanction the site
+    findings = _src(
+        """
+        from jax import lax
+
+        def f(x, axis):
+            return lax.all_gather(x, axis)  # gather-ok
+        """
+    )
+    assert _codes(findings) == ["COLLGATHER"]
 
 
 # ---------------------------------------------------------------------------
@@ -260,7 +284,7 @@ def test_syntax_error_is_a_parse_finding():
     assert _codes(findings) == ["PARSE"]
 
 
-def test_registry_has_five_passes_in_order():
+def test_registry_has_six_passes_in_order():
     passes = list(analysis.load_passes())
     assert passes == [
         "hot-loop",
@@ -268,6 +292,7 @@ def test_registry_has_five_passes_in_order():
         "donation-safety",
         "lock-discipline",
         "trace-safety",
+        "collective-discipline",
     ]
 
 

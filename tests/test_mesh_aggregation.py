@@ -237,9 +237,10 @@ def test_mesh_runner_rides_packed_wire_ingest(monkeypatch):
             )
 
     agg = ConnectedComponents()
-    calls = {"wire": 0, "raw": 0}
+    calls = {"wire": 0, "raw": 0, "sharded_wire": 0, "sharded_raw": 0}
     orig_wire = agg_mod.MeshAggregationRunner._pane_step_wire
     orig_raw = agg_mod.MeshAggregationRunner._pane_step
+    orig_sharded = agg_mod.MeshAggregationRunner._pane_step_sharded
 
     def spy_wire(self, *a, **k):
         calls["wire"] += 1
@@ -249,10 +250,32 @@ def test_mesh_runner_rides_packed_wire_ingest(monkeypatch):
         calls["raw"] += 1
         return orig_raw(self, *a, **k)
 
+    def spy_sharded(self, cfg2, spec, cap, kind, ctx):
+        calls["sharded_" + kind[0]] += 1
+        return orig_sharded(self, cfg2, spec, cap, kind, ctx)
+
     monkeypatch.setattr(agg_mod.MeshAggregationRunner, "_pane_step_wire", spy_wire)
     monkeypatch.setattr(agg_mod.MeshAggregationRunner, "_pane_step", spy_raw)
+    monkeypatch.setattr(
+        agg_mod.MeshAggregationRunner, "_pane_step_sharded", spy_sharded
+    )
     out = EdgeStream.from_batches(batches, cfg).aggregate(agg).collect()
+    # the default (owner-sharded) plane still ships packed wire rows, and
+    # nothing falls back to raw int32 buckets
+    assert calls["sharded_wire"] > 0 and calls["sharded_raw"] == 0
+    assert calls["raw"] == 0
+    # the replicated oracle plane keeps its packed-wire ingest too
+    import dataclasses
+
+    out_rep = (
+        EdgeStream.from_batches(
+            batches, dataclasses.replace(cfg, sharded_state=0)
+        )
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
     assert calls["wire"] > 0 and calls["raw"] == 0
+    assert out_rep[-1][0].components() == out[-1][0].components()
     # and the final summary matches the single-shard runtime over one stream
     single_cfg = StreamConfig(vertex_capacity=64, batch_size=64, window_ms=1000)
     single = (

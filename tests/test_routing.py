@@ -200,6 +200,217 @@ def test_salted_routing_survives_zipf_skew():
     assert out["plain_recv_imbalance"] > out["salted_recv_imbalance"]
 
 
+def test_device_route_fuzz_vs_host_route_oracle():
+    """device_route must deliver exactly host_route's multiset per owner —
+    fuzzed over skewed (every edge one shard), empty-shard, and valued-pytree
+    distributions (ISSUE 4 satellite)."""
+    import jax
+
+    from gelly_streaming_tpu.parallel.routing import pow2_bucket
+
+    n_shards, b = 8, 24
+    mesh = make_mesh(n_shards)
+
+    def run_device(src, dst, mask, cap, val=None):
+        def body(s, d, m, *v):
+            routed = device_route(
+                s.reshape(-1),
+                d.reshape(-1),
+                m.reshape(-1),
+                n_shards,
+                cap,
+                val=jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), v[0])
+                if v
+                else None,
+            )
+            out = (routed.src, routed.dst, routed.mask, routed.dropped.reshape(1))
+            if v:
+                out = out + (routed.val,)
+            return out
+
+        n_out = 5 if val is not None else 4
+        specs_in = (P("shards"),) * 3 + ((P("shards"),) if val is not None else ())
+        route = jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=specs_in,
+                out_specs=(P("shards"),) * n_out,
+            )
+        )
+        args = [jnp.asarray(src), jnp.asarray(dst), jnp.asarray(mask)]
+        if val is not None:
+            args.append(jax.tree.map(jnp.asarray, val))
+        out = route(*args)
+        cap_b = pow2_bucket(cap)
+        rs = np.asarray(out[0]).reshape(n_shards, -1)
+        rd = np.asarray(out[1]).reshape(n_shards, -1)
+        rm = np.asarray(out[2]).reshape(n_shards, -1)
+        dropped = int(np.asarray(out[3]).sum())
+        rv = None
+        if val is not None:
+            rv = jax.tree.map(
+                lambda a: np.asarray(a).reshape((n_shards, n_shards * cap_b) + a.shape[1:]),
+                out[4],
+            )
+        return rs, rd, rm, dropped, rv
+
+    rng = np.random.default_rng(77)
+    cases = []
+    # uniform
+    cases.append((rng.integers(0, 64, (n_shards, b)), rng.integers(0, 64, (n_shards, b)), rng.random((n_shards, b)) < 0.9, None))
+    # skewed: EVERY edge keyed to shard 3
+    cases.append((rng.integers(0, 8, (n_shards, b)) * 8 + 3, rng.integers(0, 64, (n_shards, b)), np.ones((n_shards, b), bool), None))
+    # empty shards: only shard 0's rows valid, keyed to two owners
+    m = np.zeros((n_shards, b), bool)
+    m[0] = True
+    cases.append((rng.integers(0, 2, (n_shards, b)) * 8 + rng.integers(0, 2, (n_shards, b)), rng.integers(0, 64, (n_shards, b)), m, None))
+    # valued pytree payload
+    val = {
+        "w": rng.normal(size=(n_shards, b)).astype(np.float32),
+        "tag": rng.integers(0, 100, (n_shards, b, 2)).astype(np.int32),
+    }
+    cases.append((rng.integers(0, 64, (n_shards, b)), rng.integers(0, 64, (n_shards, b)), rng.random((n_shards, b)) < 0.8, val))
+
+    for src, dst, mask, v in cases:
+        src = src.astype(np.int32)
+        dst = dst.astype(np.int32)
+        cap = n_shards * b  # lossless: a shard may send its whole batch to one owner
+        rs, rd, rm, dropped, rv = run_device(src, dst, mask, cap, v)
+        assert dropped == 0
+        flat_sel = mask.reshape(-1)
+        oracle = host_route(
+            src.reshape(-1)[flat_sel],
+            dst.reshape(-1)[flat_sel],
+            n_shards,
+            val=None
+            if v is None
+            else jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:])[flat_sel], v),
+        )
+        for shard in range(n_shards):
+            got = sorted(
+                (int(s), int(d))
+                for s, d, ok in zip(rs[shard], rd[shard], rm[shard])
+                if ok
+            )
+            want = sorted(
+                (int(s), int(d))
+                for s, d, ok in zip(
+                    oracle.src[shard], oracle.dst[shard], oracle.mask[shard]
+                )
+                if ok
+            )
+            assert got == want, f"shard {shard} multiset mismatch"
+            if v is not None:
+                got_v = sorted(
+                    (int(s), float(w), tuple(int(x) for x in tg))
+                    for s, w, tg, ok in zip(
+                        rs[shard],
+                        rv["w"][shard],
+                        rv["tag"][shard],
+                        rm[shard],
+                    )
+                    if ok
+                )
+                want_v = sorted(
+                    (int(s), float(w), tuple(int(x) for x in tg))
+                    for s, w, tg, ok in zip(
+                        oracle.src[shard],
+                        oracle.val["w"][shard],
+                        oracle.val["tag"][shard],
+                        oracle.mask[shard],
+                    )
+                    if ok
+                )
+                assert got_v == want_v, f"shard {shard} payload mismatch"
+
+
+def test_host_route_auto_capacity_is_pow2_bucketed():
+    from gelly_streaming_tpu.parallel.routing import pow2_bucket
+
+    rng = np.random.default_rng(3)
+    for n in (7, 33, 130):
+        src = rng.integers(0, 64, n).astype(np.int32)
+        dst = rng.integers(0, 64, n).astype(np.int32)
+        routed = host_route(src, dst, 8)
+        cap = routed.src.shape[1]
+        assert cap == pow2_bucket(cap), cap  # a power of two
+    # explicit capacities are honored as given (no silent reshaping)
+    routed = host_route(src, dst, 8, capacity=50)
+    assert routed.src.shape[1] == 50
+
+
+def test_pack_slab_deltas_matches_numpy_oracle():
+    """The delta-buffer compaction: changed rows land per owner in block-row
+    order, padding carries the fill, occupancy/spill/sent are exact."""
+    import jax
+
+    from gelly_streaming_tpu.parallel.routing import DELTA_PAD, pack_slab_deltas
+
+    rng = np.random.default_rng(5)
+    C, S_, cap = 64, 8, 4
+    changed = rng.random(C) < 0.4
+    values = rng.integers(0, 1000, C).astype(np.int32)
+    rows, vals, sent, occ, spilled = jax.jit(
+        lambda c, v: pack_slab_deltas(c, v, S_, cap, fill=-7)
+    )(jnp.asarray(changed), jnp.asarray(values))
+    rows, vals, sent = np.asarray(rows), np.asarray(vals), np.asarray(sent)
+    demand = np.zeros(S_, np.int64)
+    for owner in range(S_):
+        ids = [g for g in range(C) if g % S_ == owner and changed[g]]
+        demand[owner] = len(ids)
+        kept = ids[:cap]
+        got = [(int(r), int(x)) for r, x in zip(rows[owner], vals[owner]) if r != DELTA_PAD]
+        assert got == [(g // S_, int(values[g])) for g in kept]
+        # padding slots carry the fill value
+        assert all(int(x) == -7 for r, x in zip(rows[owner], vals[owner]) if r == DELTA_PAD)
+        for g in ids:
+            assert bool(sent[g]) == (g in kept)
+    assert int(occ) == demand.max()
+    assert int(spilled) == int(np.maximum(demand - cap, 0).sum())
+    assert not sent[~changed].any()
+
+
+def test_slab_exchange_and_gather_blocks_roundtrip():
+    """slab_exchange routes owner slabs; gather_blocks reassembles the
+    modulo-interleaved full view — together they invert block sharding."""
+    import jax
+
+    from gelly_streaming_tpu.parallel.routing import gather_blocks, slab_exchange
+
+    S_ = 8
+    C = 64
+    mesh = make_mesh(S_)
+    full = np.arange(S_ * C, dtype=np.int32).reshape(S_, C)  # per-shard [C] views
+
+    def body(v, blk):
+        recv = slab_exchange(v[0], S_, "shards")
+        # keep MY slab of my own view: what shard me sent to me
+        me = jax.lax.axis_index("shards")
+        own = recv[me]
+        return recv[None], gather_blocks(blk[0], S_, "shards")[None], own[None]
+
+    blocks = np.arange(C, dtype=np.int32).reshape(-1, S_).T.copy()  # [S, C/S]
+    f = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P("shards"), P("shards")),
+            out_specs=(P("shards"), P("shards"), P("shards")),
+        )
+    )
+    recv, gathered, own = f(jnp.asarray(full), jnp.asarray(blocks))
+    recv = np.asarray(recv).reshape(S_, S_, C // S_)
+    # shard o's received slab from sender s == sender s's values for o's rows
+    for o in range(S_):
+        for s in range(S_):
+            assert np.array_equal(recv[o, s], full[s].reshape(-1, S_).T[o])
+    # gather_blocks reassembles v = s + S*i from blocks[s, i]
+    gathered = np.asarray(gathered).reshape(S_, C)
+    for o in range(S_):
+        assert np.array_equal(gathered[o], np.arange(C, dtype=np.int32))
+
+
 def test_routing_measurement_cli():
     """The measurements CLI surfaces the same line end-to-end via argv."""
     import contextlib

@@ -1,0 +1,463 @@
+"""Owner-sharded summary state (ISSUE 4): equivalence, recovery, comms.
+
+The sharded plane (core/sharded_state.py) must be BIT-IDENTICAL to the
+replicated combine it replaced — cfg.sharded_state=0 keeps the old plane
+alive as the in-tree oracle, so every test here runs both and compares
+emissions, across the wire streaming fold, event-time windows (incl. late
+records and sliding panes), ingestion-time panes, kill-and-resume, and both
+library descriptors (CC and the degree summary).  The comms counters and
+the retrace guard pin the two quantitative claims: collective bytes stay in
+the O(C/S + delta) envelope (never the replicated plane's O(C*S)
+full-partial gathers), and the pow2-bucketed capacities keep the compiled
+step set closed (0 recompiles across same-bucket panes).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.connected_components import ConnectedComponents
+from gelly_streaming_tpu.library.degree_distribution import (
+    DegreeDistributionSummary,
+    degree_histogram,
+)
+
+CAP = 64
+S = 8
+
+
+def _cfg(**kw):
+    base = dict(vertex_capacity=CAP, batch_size=64, num_shards=S, window_ms=1000)
+    base.update(kw)
+    return StreamConfig(**base)
+
+
+def _both(cfg):
+    return (
+        dataclasses.replace(cfg, sharded_state=1),
+        dataclasses.replace(cfg, sharded_state=0),
+    )
+
+
+def _rand_edges(n, seed=0, cap=CAP):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, cap, n).astype(np.int32),
+        rng.integers(0, cap, n).astype(np.int32),
+    )
+
+
+def _timed_edges(n, seed=0, span_ms=3000, cap=CAP):
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.integers(0, span_ms, n)).astype(np.int64)
+    s, d = _rand_edges(n, seed, cap)
+    return [(int(s[i]), int(d[i]), 0.0, int(t[i])) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# emission equivalence: sharded plane == replicated oracle, bit for bit
+
+
+def test_cc_wire_stream_matches_replicated_oracle():
+    src, dst = _rand_edges(500, seed=3)
+    on, off = _both(_cfg())
+    got = EdgeStream.from_arrays(src, dst, on).aggregate(ConnectedComponents()).collect()
+    exp = EdgeStream.from_arrays(src, dst, off).aggregate(ConnectedComponents()).collect()
+    assert np.array_equal(np.asarray(got[-1][0].parent), np.asarray(exp[-1][0].parent))
+    assert np.array_equal(np.asarray(got[-1][0].seen), np.asarray(exp[-1][0].seen))
+
+
+def test_cc_wire_replay_with_tail_matches_oracle():
+    from gelly_streaming_tpu.io import wire
+
+    src, dst = _rand_edges(500, seed=4)
+    width = wire.width_for_capacity(CAP)
+    bufs, tail = wire.pack_stream(src, dst, 64, width)
+    assert tail is not None
+    on, off = _both(_cfg())
+    got = (
+        EdgeStream.from_wire(bufs, 64, width, on, tail=tail)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    exp = (
+        EdgeStream.from_wire(bufs, 64, width, off, tail=tail)
+        .aggregate(ConnectedComponents())
+        .collect()
+    )
+    assert np.array_equal(np.asarray(got[-1][0].parent), np.asarray(exp[-1][0].parent))
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents, DegreeDistributionSummary])
+def test_windowed_emissions_match_replicated_oracle(agg_cls):
+    edges = _timed_edges(200, seed=5)
+    on, off = _both(_cfg(batch_size=16))
+    got = [
+        o[0]
+        for o in EdgeStream.from_collection(edges, on, 16, with_time=True).aggregate(
+            agg_cls()
+        )
+    ]
+    exp = [
+        o[0]
+        for o in EdgeStream.from_collection(edges, off, 16, with_time=True).aggregate(
+            agg_cls()
+        )
+    ]
+    assert len(got) == len(exp) >= 3
+    for g, e in zip(got, exp):
+        ga = np.asarray(g.parent if hasattr(g, "parent") else g)
+        ea = np.asarray(e.parent if hasattr(e, "parent") else e)
+        assert np.array_equal(ga, ea)
+
+
+def test_degree_summary_matches_numpy():
+    src, dst = _rand_edges(400, seed=6)
+    on, _ = _both(_cfg())
+    out = (
+        EdgeStream.from_arrays(src, dst, on)
+        .aggregate(DegreeDistributionSummary())
+        .collect()
+    )
+    expect = np.bincount(src, minlength=CAP) + np.bincount(dst, minlength=CAP)
+    assert np.array_equal(np.asarray(out[-1][0]), expect)
+    assert degree_histogram(out[-1][0]) == degree_histogram(expect)
+
+
+def test_late_records_match_replicated_oracle():
+    """Bounded out-of-orderness: stragglers within the bound re-open panes
+    identically on both planes; later-than-bound records go late on both."""
+    edges = _timed_edges(120, seed=7, span_ms=4000)
+    # shuffle a straggler window in: move some mid-stream events early
+    edges[40] = (edges[40][0], edges[40][1], 0.0, edges[39][3] - 900)
+    edges[80] = (edges[80][0], edges[80][1], 0.0, edges[79][3] - 900)
+    edges.sort(key=lambda e: e[3])
+    # then displace two records to arrive 700ms late relative to arrival order
+    late1, late2 = edges.pop(30), edges.pop(60)
+    edges.insert(45, (late1[0], late1[1], 0.0, late1[3]))
+    edges.append((late2[0], late2[1], 0.0, late2[3]))
+    on, off = _both(_cfg(batch_size=8, out_of_orderness_ms=1000))
+    got = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, on, 8, with_time=True).aggregate(
+            ConnectedComponents()
+        )
+    ]
+    exp = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, off, 8, with_time=True).aggregate(
+            ConnectedComponents()
+        )
+    ]
+    assert got == exp
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents, DegreeDistributionSummary])
+def test_sliding_windows_match_replicated_oracle(agg_cls):
+    """Pane-shared sliding windows via the runner's panes override: the
+    sharded plane's persistent fold must equal the replicated running merge
+    (the combine(a, update(init, e)) == update(a, e) protocol contract)."""
+    from gelly_streaming_tpu.core.aggregation import MeshAggregationRunner
+    from gelly_streaming_tpu.core.windows import windowed_panes
+
+    edges = _timed_edges(160, seed=8, span_ms=4000)
+    on, off = _both(_cfg(batch_size=16))
+
+    def run(cfg):
+        stream = EdgeStream.from_collection(edges, cfg, 16, with_time=True)
+        agg = agg_cls()
+        runner = MeshAggregationRunner(agg)
+        return [
+            o[0]
+            for o in runner.run(
+                stream, panes=lambda: windowed_panes(stream, 1000, 500)
+            )
+        ]
+
+    got, exp = run(on), run(off)
+    assert len(got) == len(exp) >= 4
+    for g, e in zip(got, exp):
+        ga = np.asarray(g.parent if hasattr(g, "parent") else g)
+        ea = np.asarray(e.parent if hasattr(e, "parent") else e)
+        assert np.array_equal(ga, ea)
+
+
+def test_ingestion_panes_match_replicated_oracle():
+    src, dst = _rand_edges(300, seed=9)
+    on, off = _both(_cfg(batch_size=32, ingest_window_edges=64))
+    got = [
+        str(o[0])
+        for o in EdgeStream.from_arrays(src, dst, on).aggregate(ConnectedComponents())
+    ]
+    exp = [
+        str(o[0])
+        for o in EdgeStream.from_arrays(src, dst, off).aggregate(ConnectedComponents())
+    ]
+    assert got == exp and len(got) >= 4
+
+
+def test_async_windows_match_sync_on_sharded_plane():
+    edges = _timed_edges(200, seed=10)
+    base = _cfg(batch_size=16, sharded_state=1)
+    sync_cfg = dataclasses.replace(base, async_windows=0)
+    async_cfg = dataclasses.replace(base, async_windows=3)
+    got = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, async_cfg, 16, with_time=True).aggregate(
+            ConnectedComponents()
+        )
+    ]
+    exp = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, sync_cfg, 16, with_time=True).aggregate(
+            ConnectedComponents()
+        )
+    ]
+    assert got == exp
+
+
+def test_transient_descriptor_resets_blocks_per_window():
+    """transient_state on the sharded plane: blocks reset per pane, so each
+    emission covers only its own window — same as the replicated plane."""
+
+    class TransientCC(ConnectedComponents):
+        transient_state = True
+
+        @property
+        def cache_token(self):
+            return type(self)
+
+    edges = _timed_edges(120, seed=11)
+    on, off = _both(_cfg(batch_size=16))
+    got = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, on, 16, with_time=True).aggregate(
+            TransientCC()
+        )
+    ]
+    exp = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, off, 16, with_time=True).aggregate(
+            TransientCC()
+        )
+    ]
+    assert got == exp and len(got) >= 2
+
+
+# ---------------------------------------------------------------------------
+# recovery: positional checkpoints + kill-and-resume parity
+
+
+def test_windowed_kill_and_resume_position_parity(tmp_path):
+    """Abandon the sharded windowed plane mid-stream; the resume must skip
+    checkpointed windows by position and replay the rest — matching both
+    the full sharded run and the replicated oracle's resumed sequence."""
+    edges = _timed_edges(160, seed=12)
+    on, off = _both(_cfg(batch_size=16))
+    full = [
+        str(o[0])
+        for o in EdgeStream.from_collection(edges, on, 16, with_time=True).aggregate(
+            ConnectedComponents()
+        )
+    ]
+
+    def killed_then_resumed(cfg, ckpt):
+        it = iter(
+            EdgeStream.from_collection(edges, cfg, 16, with_time=True).aggregate(
+                ConnectedComponents(), checkpoint_path=ckpt
+            )
+        )
+        first_two = [str(next(it)[0]), str(next(it)[0])]
+        it.close()
+        assert os.path.exists(ckpt)
+        resumed = [
+            str(o[0])
+            for o in EdgeStream.from_collection(edges, cfg, 16, with_time=True).aggregate(
+                ConnectedComponents(), checkpoint_path=ckpt
+            )
+        ]
+        return first_two, resumed
+
+    first_on, resumed_on = killed_then_resumed(
+        on, os.path.join(str(tmp_path), "sharded.npz")
+    )
+    first_off, resumed_off = killed_then_resumed(
+        off, os.path.join(str(tmp_path), "replicated.npz")
+    )
+    assert first_on == full[:2]
+    # window 1's snapshot never landed (generator killed at the yield), so
+    # it re-emits: at-least-once, identical on both planes
+    assert resumed_on == full[1:]
+    assert resumed_on == resumed_off
+
+
+def test_wire_kill_and_resume_uses_restored_position(tmp_path):
+    """Mid-stream wire snapshot: resuming over a POISONED already-folded
+    prefix must still reach the full run's summary — proof the restored
+    blocks + group position were used instead of re-folding."""
+    src, dst = _rand_edges(512, seed=13)
+    cfg = _cfg(batch_size=64, wire_checkpoint_batches=8, sharded_state=1)
+    ckpt = os.path.join(str(tmp_path), "wire.npz")
+    full = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents(), checkpoint_path=ckpt)
+        .collect()
+    )
+    assert os.path.exists(ckpt)
+    # done snapshot: resume re-emits (at-least-once) from blocks alone
+    again = (
+        EdgeStream.from_arrays(src, dst, cfg)
+        .aggregate(ConnectedComponents(), checkpoint_path=ckpt)
+        .collect()
+    )
+    assert again[-1][0].components() == full[-1][0].components()
+
+    os.remove(ckpt)
+    it = iter(
+        EdgeStream.from_arrays(src, dst, cfg).aggregate(
+            ConnectedComponents(), checkpoint_path=ckpt
+        )
+    )
+    try:
+        next(it)
+    except StopIteration:
+        pass
+    it.close()
+    assert os.path.exists(ckpt)
+    garbled = src.copy()
+    garbled[:256] = 0  # poison the folded prefix
+    resumed = (
+        EdgeStream.from_arrays(garbled, dst, cfg)
+        .aggregate(ConnectedComponents(), checkpoint_path=ckpt)
+        .collect()
+    )
+    assert resumed[-1][0].components() == full[-1][0].components()
+
+
+def test_wire_checkpoint_geometry_mismatch_raises(tmp_path):
+    src, dst = _rand_edges(512, seed=14)
+    cfg = _cfg(batch_size=64, wire_checkpoint_batches=8, sharded_state=1)
+    ckpt = os.path.join(str(tmp_path), "wire.npz")
+    it = iter(
+        EdgeStream.from_arrays(src, dst, cfg).aggregate(
+            ConnectedComponents(), checkpoint_path=ckpt
+        )
+    )
+    try:
+        next(it)
+    except StopIteration:
+        pass
+    it.close()
+    assert os.path.exists(ckpt)
+    bad = dataclasses.replace(cfg, batch_size=32)
+    with pytest.raises(ValueError, match="misalign"):
+        EdgeStream.from_arrays(src, dst, bad).aggregate(
+            ConnectedComponents(), checkpoint_path=ckpt
+        ).collect()
+
+
+# ---------------------------------------------------------------------------
+# comms accounting + the retrace guard
+
+
+def test_comms_counters_meter_the_sharded_plane():
+    from gelly_streaming_tpu.utils import metrics
+
+    src, dst = _rand_edges(400, seed=15)
+    on, off = _both(_cfg(batch_size=64))
+    metrics.reset_comms_stats()
+    EdgeStream.from_arrays(src, dst, on).aggregate(ConnectedComponents()).collect()
+    stats = metrics.comms_stats()
+    assert stats["comms_dispatches"] > 0
+    assert stats["comms_bytes_exchange"] > 0
+    assert stats["comms_bytes_gather"] > 0
+    assert stats["comms_exchange_rounds"] >= 1
+    assert stats["comms_delta_spilled"] == 0
+    # the O(C/S + delta) envelope per dispatch, and never the O(C*S) regime
+    # of gathering S full partials per shard per dispatch
+    c = CAP
+    assert stats["comms_bytes_per_dispatch"] <= 8 * (5 * c + 16 * c)
+    assert stats["comms_bytes_per_dispatch"] < S * c * 4 * S
+    # the replicated oracle plane leaves the counters untouched
+    metrics.reset_comms_stats()
+    EdgeStream.from_arrays(src, dst, off).aggregate(ConnectedComponents()).collect()
+    assert metrics.comms_stats()["comms_dispatches"] == 0
+
+
+def test_delta_occupancy_tracks_changed_rows_not_capacity():
+    """Small panes on a large id space: the measured delta high-water mark
+    must scale with the pane's changed rows (the GraphBLAST frontier), not
+    with C/S — the claim behind the delta-compressed buffers."""
+    from gelly_streaming_tpu.utils import metrics
+
+    big = 1 << 12
+    cfg = _cfg(
+        vertex_capacity=big, batch_size=16, window_ms=1000, sharded_state=1
+    )
+    edges = _timed_edges(96, seed=16, span_ms=6000, cap=big)
+    metrics.reset_comms_stats()
+    EdgeStream.from_collection(edges, cfg, 16, with_time=True).aggregate(
+        ConnectedComponents()
+    ).collect()
+    stats = metrics.comms_stats()
+    hwm = stats["comms_delta_occupancy_hwm"]
+    assert 0 < hwm <= 2 * 96  # bounded by touched rows...
+    assert hwm < big // S  # ...far under the structural C/S ceiling
+
+
+def test_zero_recompiles_across_same_bucket_panes():
+    """Retrace guard (satellite): 50 windows whose occupancy varies inside
+    one pow2 capacity bucket reuse ONE compiled sharded step — second run
+    of the whole stream compiles nothing and recompiles nothing."""
+    from gelly_streaming_tpu.core import compile_cache
+
+    rng = np.random.default_rng(17)
+    edges = []
+    t = 0
+    for w in range(50):
+        n = int(rng.integers(33, 65))  # same pow2 bucket at every window
+        s, d = _rand_edges(n, seed=100 + w)
+        for i in range(n):
+            edges.append((int(s[i]), int(d[i]), 0.0, t + i))
+        t += 1000
+    cfg = _cfg(batch_size=64, sharded_state=1)
+
+    def run(agg_cls):
+        return (
+            EdgeStream.from_collection(edges, cfg, 64, with_time=True)
+            .aggregate(agg_cls())
+            .collect()
+        )
+
+    # CC rides round-robin pane packing; the degree summary rides the
+    # host_route keyBy (route_key="src"), whose auto capacity pow2-buckets —
+    # both planes must resolve every same-bucket pane to cached executables
+    out1 = run(ConnectedComponents)  # populate the executable cache
+    run(DegreeDistributionSummary)
+    compile_cache.reset_stats()
+    out2 = run(ConnectedComponents)  # re-created streams AND descriptors:
+    run(DegreeDistributionSummary)  # everything must hit
+    stats = compile_cache.stats()
+    assert len(out2) == 50
+    assert stats["compiles"] == 0, stats
+    assert stats["recompiles"] == 0, stats
+    assert str(out1[-1][0]) == str(out2[-1][0])
+
+
+def test_sharded_state_env_and_config_resolution(monkeypatch):
+    from gelly_streaming_tpu.core.sharded_state import resolve_sharded_state
+
+    assert resolve_sharded_state(_cfg(sharded_state=1))
+    assert not resolve_sharded_state(_cfg(sharded_state=0))
+    monkeypatch.delenv("GELLY_SHARDED_STATE", raising=False)
+    assert resolve_sharded_state(_cfg())  # auto defaults ON
+    monkeypatch.setenv("GELLY_SHARDED_STATE", "0")
+    assert not resolve_sharded_state(_cfg())
+    monkeypatch.setenv("GELLY_SHARDED_STATE", "1")
+    assert resolve_sharded_state(_cfg())
+    # explicit config wins over the env var
+    assert not resolve_sharded_state(_cfg(sharded_state=0))
