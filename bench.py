@@ -377,6 +377,103 @@ def _async_window_bench(
     }
 
 
+def _multi_tenant_bench(
+    windows: int = 40, win_edges: int = 1 << 13, capacity: int = 1 << 16
+):
+    """Multi-tenant job runtime sweep (ISSUE 5): jobs in {1, 2, 4}.
+
+    Same-shape streaming-CC queries over the wire fast path with running
+    per-window emission, co-scheduled by the JobManager on one device
+    pipeline.  Reported: aggregate eps per job count, per-job fairness at
+    4 jobs (min/max job-throughput ratio — jobs are identical, so a fair
+    scheduler finishes them at near-identical rates), scheduler overhead
+    (1 runtime job vs the same query run directly), and the retrace guard
+    (same-shape jobs must share executables: 0 recompiles after the
+    single-job warmup).
+    """
+    from gelly_streaming_tpu.core import compile_cache
+    from gelly_streaming_tpu.core.config import RuntimeConfig, StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.utils import metrics
+
+    n = windows * win_edges
+    bs = win_edges // 2  # aligned: windows cut on batch boundaries
+    cfg = StreamConfig(
+        vertex_capacity=capacity, batch_size=bs, ingest_window_edges=win_edges
+    )
+    rng = np.random.default_rng(11)
+    datasets = [
+        (
+            rng.integers(0, capacity, n).astype(np.int32),
+            rng.integers(0, capacity, n).astype(np.int32),
+        )
+        for _ in range(4)
+    ]
+
+    def direct_run():
+        stream = EdgeStream.from_arrays(*datasets[0], cfg)
+        for rec in stream.aggregate(ConnectedComponents()):
+            np.asarray(rec[0].parent)  # materialize: the sink contract
+
+    direct_run()  # the single job's warmup: compiles land here
+    t0 = time.perf_counter()
+    direct_run()
+    single_eps = n / (time.perf_counter() - t0)
+
+    compile_cache.reset_stats()
+    out = {"multi_tenant_single_eps": round(single_eps, 1)}
+    for n_jobs in (1, 2, 4):
+        metrics.reset_job_stats()
+        finish = {}
+        t0 = time.perf_counter()
+        # quantum 1: finest interleaving, so per-job finish-time skew (the
+        # fairness figure) measures the scheduler, not the round size
+        with JobManager(
+            RuntimeConfig(max_jobs=8, fair_quantum=1)
+        ) as manager:
+            for i in range(n_jobs):
+                def sink(rec, i=i):
+                    np.asarray(rec[0].parent)  # materialize per emission
+                    finish[i] = time.perf_counter()
+
+                manager.submit_aggregation(
+                    EdgeStream.from_arrays(*datasets[i], cfg),
+                    ConnectedComponents(),
+                    name=f"cc-{n_jobs}x-{i}",
+                    sink=sink,
+                )
+            manager.wait_all()
+        wall = time.perf_counter() - t0
+        agg_eps = n_jobs * n / wall
+        out[f"multi_tenant_eps_{n_jobs}"] = round(agg_eps, 1)
+        per_job_eps = [n / (finish[i] - t0) for i in range(n_jobs)]
+        out[f"multi_tenant_fairness_{n_jobs}"] = round(
+            min(per_job_eps) / max(per_job_eps), 3
+        )
+    out["multi_tenant_overhead"] = round(
+        out["multi_tenant_eps_1"] / single_eps, 3
+    )
+    out["multi_tenant_agg_ratio_4"] = round(
+        out["multi_tenant_eps_4"] / single_eps, 3
+    )
+    out["multi_tenant_recompiles"] = compile_cache.stats()["recompiles"]
+    out["multi_tenant_compiles_after_warm"] = compile_cache.stats()[
+        "compiles"
+    ]
+    out.update(
+        {
+            f"multi_tenant_{k}": v
+            for k, v in metrics.job_totals().items()
+            if k in ("job_records", "job_queue_full_skips")
+        }
+    )
+    return out
+
+
 _PARTIAL = {}  # best results so far, emitted by the deadline watchdog
 
 
@@ -851,6 +948,32 @@ def main():
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"async window bench skipped: {e}", file=sys.stderr)
 
+    # ---- multi-tenant job runtime: jobs in {1, 2, 4} over one pipeline -----
+    # (ISSUE 5 acceptance: 4 same-shape jobs at >= 0.8x the single-job
+    # baseline with 0 recompiles after warmup and near-1.0 fairness)
+    try:
+        if os.environ.get("GELLY_BENCH_MULTITENANT", "1") != "0":
+            mt_stats = _multi_tenant_bench(
+                windows=int(os.environ.get("GELLY_BENCH_MT_WINDOWS", 40)),
+                win_edges=int(
+                    os.environ.get("GELLY_BENCH_MT_WIN_EDGES", 1 << 13)
+                ),
+            )
+            _PARTIAL.update(mt_stats)
+            print(
+                f"multi-tenant: single {mt_stats['multi_tenant_single_eps'] / 1e6:.2f}M"
+                f" eps; 1/2/4 jobs "
+                f"{mt_stats['multi_tenant_eps_1'] / 1e6:.2f}/"
+                f"{mt_stats['multi_tenant_eps_2'] / 1e6:.2f}/"
+                f"{mt_stats['multi_tenant_eps_4'] / 1e6:.2f}M eps aggregate "
+                f"(x{mt_stats['multi_tenant_agg_ratio_4']} of single at 4), "
+                f"fairness {mt_stats['multi_tenant_fairness_4']}, "
+                f"recompiles {mt_stats['multi_tenant_recompiles']}",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"multi-tenant bench skipped: {e}", file=sys.stderr)
+
     # ---- static-analysis attestation: the artifact doubles as a proof the
     # measured tree passes graftcheck (0 = clean; a positive count means the
     # bench ran on a tree whose invariants the suite no longer pins)
@@ -870,7 +993,14 @@ def main():
         _afindings = _analysis.analyze_paths(
             [
                 os.path.join(_aroot, d)
-                for d in ("core", "io", "library", "parallel", "utils")
+                for d in (
+                    "core",
+                    "io",
+                    "library",
+                    "parallel",
+                    "runtime",
+                    "utils",
+                )
             ],
             root=os.path.dirname(_aroot),
         )

@@ -19,6 +19,9 @@ Package map (reference counterpart in parentheses):
   library/   single-pass algorithms (library/*.java and example/*.java algorithms)
   examples/  runnable CLI programs mirroring the reference example argv contracts
   io/        sources/sinks, native-accelerated edge parsing
+  runtime/   multi-tenant job runtime: concurrent queries over one device
+             pipeline (the cluster/job-submission layer the reference gets
+             from Flink itself)
   utils/     config, metrics, checkpointing, value types (util/*.java)
 """
 
@@ -36,6 +39,10 @@ _EXPORTS = {
         "gelly_streaming_tpu.core.aggregation",
         "MeshAggregationRunner",
     ),
+    # the multi-tenant job runtime (runtime/): concurrent streaming queries
+    # scheduled over one device pipeline
+    "JobManager": ("gelly_streaming_tpu.runtime", "JobManager"),
+    "RuntimeConfig": ("gelly_streaming_tpu.core.config", "RuntimeConfig"),
 }
 
 __all__ = list(_EXPORTS)

@@ -44,9 +44,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--paths",
         nargs="+",
-        default=["core", "io", "library", "parallel"],
+        default=["core", "io", "library", "parallel", "runtime"],
         help="files/directories to scan; bare names resolve inside the "
-        "gelly_streaming_tpu package (default: core io library parallel)",
+        "gelly_streaming_tpu package (default: core io library parallel "
+        "runtime)",
     )
     parser.add_argument(
         "--select",

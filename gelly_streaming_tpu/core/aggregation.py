@@ -120,6 +120,23 @@ class SummaryAggregation:
         """
         return None
 
+    def state_nbytes(self, cfg: StreamConfig) -> int:
+        """Summary-state footprint of one instance of this query (bytes).
+
+        The admission-accounting entry point for the job runtime
+        (runtime/manager.py): ``JobManager`` sums this over admitted jobs
+        against ``RuntimeConfig.max_state_bytes``.  Computed via
+        ``jax.eval_shape`` — abstract shapes only, nothing is allocated, so
+        admission control itself cannot blow the budget it polices.
+        """
+        shapes = jax.eval_shape(lambda: self.initial_state(cfg))
+        return int(
+            sum(
+                int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree.leaves(shapes)
+            )
+        )
+
     # -- execution ------------------------------------------------------------
 
     def _num_partitions(self, cfg: StreamConfig) -> int:

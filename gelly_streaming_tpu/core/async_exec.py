@@ -217,7 +217,16 @@ def pipelined(
                     yield drain_one()
             # hot-loop-end
         except GeneratorExit:
-            raise  # consumer closed: no further yields are legal
+            # consumer closed (a cancelled job, an abandoned run): no
+            # further yields are legal, but dispatched windows still own
+            # transfer arenas whose host memory their folds may be reading
+            # (zero-copy device_put).  Drain the completion queue WITHOUT
+            # yielding — finish() waits on each fold and recycles its
+            # arenas — so cancellation neither leaks arenas nor recycles
+            # one a fold still reads.
+            while pending:
+                drain_one()
+            raise
         except BaseException:
             # deliver windows whose results already exist, then propagate
             # (the sequential path emitted them before hitting the failure)
@@ -378,7 +387,17 @@ def async_merge_loop(
                     save(drained_through, drained_global, summary)
         # hot-loop-end
     except GeneratorExit:
-        raise  # consumer closed: no further yields are legal
+        # consumer closed (JobManager.cancel / Job.close / an abandoned
+        # run): yielding is illegal here, but the completion queue still
+        # holds in-flight windows whose arenas are owned by dispatched
+        # folds.  Run them through the NORMAL drain path — drain_one waits
+        # on each window's emission (proving its fold consumed the arena's
+        # host memory) and releases the arenas — discarding the records, so
+        # a mid-flight cancel recycles every arena without corrupting one a
+        # zero-copy transfer still reads.
+        while pending:
+            drain_one()
+        raise
     except BaseException:
         # deliver windows whose folds already dispatched (the sync loop
         # emitted them before reaching the failure), then propagate

@@ -153,3 +153,54 @@ class StreamConfig:
 
 
 DEFAULT_CONFIG = StreamConfig()
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Knobs for the multi-tenant job runtime (runtime/manager.py).
+
+    ``StreamConfig`` shapes ONE query's pipeline; this shapes the process
+    that runs many of them over one device.  Admission limits are hard caps
+    enforced at ``JobManager.submit`` — rejection is an explicit
+    ``AdmissionError``, never a queue that silently grows or a submit that
+    hangs.
+
+    Attributes:
+      max_jobs: concurrent non-terminal jobs admitted (the reference's
+        cluster-slot analog: a Flink job needs a free task slot or the
+        submission is rejected up front).
+      max_state_bytes: aggregate summary-state footprint across admitted
+        jobs (descriptor ``state_nbytes`` at admission; 0 = unbounded).
+        Bounds device/arena memory, which job count alone does not: one
+        2^24-capacity job outweighs dozens of 2^16 ones.
+      job_queue_depth: per-job bounded emission queue length — the
+        isolation boundary between the shared dispatch loop and each job's
+        sink.  A full queue makes that ONE job unrunnable for the round;
+        it never blocks the scheduler thread.
+      fair_quantum: iterator pulls per unit of job weight per scheduling
+        round.  A weight-2 job gets twice the pulls of a weight-1 job per
+        round — weighted fairness in dispatch opportunities, which for
+        same-shape windows is weighted fairness in device time.
+      keep_terminal_jobs: finished/failed/cancelled jobs retained for
+        ``status()`` history.  Older terminal jobs are evicted at the next
+        submit (their source closures were already dropped at the terminal
+        transition), bounding a long-lived serving process's footprint.
+    """
+
+    max_jobs: int = 8
+    max_state_bytes: int = 0
+    job_queue_depth: int = 64
+    fair_quantum: int = 4
+    keep_terminal_jobs: int = 64
+
+    def __post_init__(self):
+        if self.max_jobs <= 0:
+            raise ValueError("max_jobs must be positive")
+        if self.max_state_bytes < 0:
+            raise ValueError("max_state_bytes must be >= 0 (0 = unbounded)")
+        if self.job_queue_depth <= 0:
+            raise ValueError("job_queue_depth must be positive")
+        if self.fair_quantum <= 0:
+            raise ValueError("fair_quantum must be positive")
+        if self.keep_terminal_jobs < 0:
+            raise ValueError("keep_terminal_jobs must be >= 0")

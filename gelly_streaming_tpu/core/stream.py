@@ -262,6 +262,23 @@ class EdgeStream:
         self._late_holder["sink"] = sink
         return self
 
+    def num_edges_hint(self) -> Optional[int]:
+        """Total edge count when the SOURCE knows it (array/wire-backed
+        streams), else None.
+
+        Used by the job runtime (``JobManager.submit_aggregation`` stores
+        it on the job; ``status()`` reports it as ``edges_hint`` next to
+        the measured ``job_edges``) — a hint only: stages that drop edges
+        (filters, distinct) make the true consumed count smaller, and
+        opaque batch sources simply report None.
+        """
+        if self._wire_arrays is not None:
+            return len(self._wire_arrays[0])
+        if self._wire_packed is not None:
+            bufs, batch_size, _width, tail = self._wire_packed
+            return len(bufs) * batch_size + (len(tail[0]) if tail else 0)
+        return None
+
     # ---- construction -------------------------------------------------------
 
     @staticmethod

@@ -1,0 +1,34 @@
+"""Multi-tenant job runtime: concurrent streaming queries, one device
+pipeline (ISSUE 5).
+
+Public surface::
+
+    from gelly_streaming_tpu.runtime import JobManager, RuntimeConfig
+
+    with JobManager(RuntimeConfig(max_jobs=4)) as jm:
+        job = jm.submit_aggregation(stream, ConnectedComponents())
+        for record in job.results():
+            ...
+
+See runtime/job.py for the lifecycle state machine and runtime/manager.py
+for the weighted-fair cooperative scheduler + admission control;
+``gelly-serve`` (runtime/serve.py) is the console driver.
+"""
+
+from gelly_streaming_tpu.core.config import RuntimeConfig
+from gelly_streaming_tpu.runtime.job import (
+    AdmissionError,
+    Job,
+    JobError,
+    JobState,
+)
+from gelly_streaming_tpu.runtime.manager import JobManager
+
+__all__ = [
+    "AdmissionError",
+    "Job",
+    "JobError",
+    "JobManager",
+    "JobState",
+    "RuntimeConfig",
+]

@@ -1,0 +1,528 @@
+"""JobManager: many concurrent streaming queries over one device pipeline.
+
+The scheduling model is COOPERATIVE: one scheduler thread round-robins the
+runnable jobs in weighted-fair rounds, pulling each job's record iterator
+``weight * fair_quantum`` times per round.  One pull advances that job's
+query by one emission — which, under the hood, dispatches its next
+window(s) through the existing pack/transfer/dispatch/drain pipeline
+(core/async_exec.py when the job's ``StreamConfig.async_windows`` > 0, the
+synchronous loops otherwise).  Nothing about the per-query execution
+changes: the same merge loops, the same checkpoints, and — decisively —
+the same process-global ``compile_cache``, so N same-shape jobs share one
+set of compiled executables and co-scheduling costs scheduling, not N
+compilations (the GraphBLAST kernel-reuse observation applied to tenancy).
+
+Isolation boundaries:
+
+* **Admission** (``submit``): bounded concurrent jobs and bounded
+  aggregate summary-state bytes.  Over-capacity submits raise
+  ``AdmissionError`` immediately — never a hang.
+* **Per-job bounded emission queues**: the scheduler only ever
+  ``put_nowait``s; a job whose sink lags until its queue fills is simply
+  skipped for the round (``job_queue_full_skips`` counts it) while other
+  jobs keep dispatching.  A slow sink slows ITS job, nothing else.
+* **Per-job checkpoints**: each job snapshots its own position/summary
+  through the unchanged ``utils/checkpoint.py`` machinery, so jobs
+  crash-resume independently.
+
+Failure is per-job too: an exception from one job's iterator marks that
+job FAILED (the cause lands on ``job.error``) and the round continues with
+the rest.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+from gelly_streaming_tpu.core.config import RuntimeConfig
+from gelly_streaming_tpu.runtime.job import (
+    _SENTINEL,
+    AdmissionError,
+    Job,
+    JobState,
+)
+from gelly_streaming_tpu.utils import metrics
+
+
+class JobManager:
+    """Submit / pause / resume / cancel / status over a shared scheduler.
+
+    Use as a context manager in tests and drivers: ``__exit__`` cancels
+    whatever is still live and joins the scheduler thread.
+    """
+
+    def __init__(self, cfg: Optional[RuntimeConfig] = None):
+        self.cfg = cfg or RuntimeConfig()
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, Job] = {}  # guarded-by: _lock
+        self._admitted_bytes = 0  # guarded-by: _lock
+        self._seq = itertools.count()
+        self._stop = False  # guarded-by: _lock
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
+        # scheduler parks on this when no job can make progress; submits,
+        # resumes, cancels, and consumer gets wake it
+        self._wake = threading.Event()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(
+        self,
+        build: Callable[[], Iterator[tuple]],
+        *,
+        name: Optional[str] = None,
+        sink: Optional[Callable] = None,
+        weight: int = 1,
+        checkpoint_path: Optional[str] = None,
+        state_bytes: int = 0,
+        edges_per_record: int = 0,
+        edges_hint: Optional[int] = None,
+    ) -> Job:
+        """Admit a query whose ``build()`` returns a fresh records iterator
+        (the ``OutputStream`` contract: ``iter(stream.aggregate(...))``).
+
+        ``state_bytes`` is the job's summary-state footprint charged
+        against ``RuntimeConfig.max_state_bytes`` (descriptors compute it
+        via ``SummaryAggregation.state_nbytes``; ``submit_aggregation``
+        fills it in).  Raises ``AdmissionError`` when either cap would be
+        exceeded — the job is NOT enqueued.
+        """
+        state_bytes = int(state_bytes)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("JobManager is shut down")
+            active = [
+                j
+                for j in self._jobs.values()
+                if not j._state_in(*JobState.TERMINAL)
+            ]
+            if len(active) >= self.cfg.max_jobs:
+                raise AdmissionError(
+                    f"job cap reached: {len(active)} active jobs >= "
+                    f"max_jobs={self.cfg.max_jobs}"
+                )
+            if (
+                self.cfg.max_state_bytes
+                and self._admitted_bytes + state_bytes
+                > self.cfg.max_state_bytes
+            ):
+                raise AdmissionError(
+                    f"state-byte cap reached: {self._admitted_bytes} admitted"
+                    f" + {state_bytes} requested > "
+                    f"max_state_bytes={self.cfg.max_state_bytes}"
+                )
+            if checkpoint_path is not None and any(
+                j.checkpoint_path == checkpoint_path
+                for j in active
+            ):
+                # two live jobs interleaving saves into ONE snapshot file
+                # would corrupt both resumes; derive per-job files from a
+                # shared prefix with utils.checkpoint.per_job_file instead
+                raise AdmissionError(
+                    f"checkpoint path {checkpoint_path!r} is already in use "
+                    "by an active job (use checkpoint.per_job_file to key a "
+                    "shared prefix per job)"
+                )
+            job_id = name or f"job-{next(self._seq)}"
+            if job_id in self._jobs and not self._jobs[job_id]._state_in(
+                *JobState.TERMINAL
+            ):
+                raise AdmissionError(f"job name {job_id!r} is already active")
+            self._evict_old_terminal()
+            job = Job(
+                job_id,
+                build,
+                manager_lock=self._lock,
+                sink=sink,
+                weight=weight,
+                checkpoint_path=checkpoint_path,
+                state_bytes=state_bytes,
+                edges_per_record=edges_per_record,
+                edges_hint=edges_hint,
+                queue_depth=self.cfg.job_queue_depth,
+            )
+            job._manager = self
+            self._jobs[job_id] = job
+            self._admitted_bytes += state_bytes
+            self._ensure_scheduler()
+        if sink is not None:
+            self._start_sink_thread(job)
+        self._wake.set()
+        return job
+
+    def submit_aggregation(
+        self,
+        stream,
+        descriptor,
+        *,
+        name: Optional[str] = None,
+        sink: Optional[Callable] = None,
+        weight: int = 1,
+        checkpoint_path: Optional[str] = None,
+    ) -> Job:
+        """Submit ``descriptor.run(stream)`` as a job — the entry point that
+        turns the aggregation runtime's loops into schedulable work.
+
+        State bytes come from ``descriptor.state_nbytes(stream.cfg)``;
+        per-record edge accounting from the stream's ingestion-pane size
+        when the source pins one (each emission covers one closed pane);
+        the total-edge progress hint from ``stream.num_edges_hint()``.
+        """
+        cfg = stream.cfg
+        state_bytes = descriptor.state_nbytes(cfg)
+        edges_per_record = cfg.ingest_window_edges or 0
+        return self.submit(
+            lambda: iter(
+                descriptor.run(stream, checkpoint_path=checkpoint_path)
+            ),
+            name=name,
+            sink=sink,
+            weight=weight,
+            checkpoint_path=checkpoint_path,
+            state_bytes=state_bytes,
+            edges_per_record=edges_per_record,
+            edges_hint=stream.num_edges_hint(),
+        )
+
+    def _evict_old_terminal(self) -> None:
+        """Bound the terminal-job history to ``keep_terminal_jobs`` (oldest
+        first; dict order is submission order).  Caller holds _lock.  The
+        evicted jobs' per-job metrics rows are dropped too — the module
+        totals keep their contribution, so a long-lived serving process's
+        footprint is bounded while its aggregates stay exact."""
+        with self._lock:
+            terminal = [
+                job_id
+                for job_id, j in self._jobs.items()
+                if j._state_in(*JobState.TERMINAL)
+            ]
+            excess = len(terminal) - self.cfg.keep_terminal_jobs
+            for job_id in terminal[: max(0, excess)]:
+                del self._jobs[job_id]
+                metrics.drop_job_stats(job_id)
+
+    # -- lifecycle commands --------------------------------------------------
+
+    def pause(self, job: Job) -> bool:
+        """Stop scheduling ``job`` after its in-progress pull completes.
+
+        The iterator stays suspended in place and the job's checkpoint
+        keeps its last saved position; ``resume`` continues exactly where
+        pulling stopped, so pause/resume is emission-exact in process and
+        checkpoint-exact across one (crash-resume replays from the
+        snapshot, the merge loops' existing contract).
+
+        Best-effort by design: the scheduler may finish or fail the job
+        concurrently with this call, so an un-pausable state (DRAINING /
+        terminal) returns False rather than racing the caller into an
+        exception — the check and the transition are one atomic step under
+        the manager lock.
+        """
+        with self._lock:
+            if not job._state_in(JobState.PENDING, JobState.RUNNING):
+                return False
+            job._transition(JobState.PAUSED)
+            return True
+
+    def resume(self, job: Job) -> bool:
+        """PAUSED -> RUNNING; False if the job is not paused (same
+        best-effort contract as ``pause``)."""
+        with self._lock:
+            if not job._state_in(JobState.PAUSED):
+                return False
+            job._transition(JobState.RUNNING)
+        self._wake.set()
+        return True
+
+    def cancel(
+        self, job: Job, wait: bool = True, timeout: Optional[float] = 30.0
+    ) -> bool:
+        """Request cancellation; the SCHEDULER performs it (closing the
+        job's iterator mid-``next()`` from another thread is illegal), so
+        the cancel rides the same thread that owns the generator: close ->
+        the merge loop's GeneratorExit drain recycles in-flight arenas ->
+        CANCELLED, with already-queued emissions left deliverable (dropping
+        them would gap the at-least-once emission contract).  With ``wait``
+        (default) blocks until terminal; returns whether the job IS
+        terminal on return."""
+        with self._lock:
+            if job._state_in(*JobState.TERMINAL):
+                return True
+            job._cancel_requested = True
+        self._wake.set()
+        if wait:
+            return job.wait(timeout)
+        return job._state_in(*JobState.TERMINAL)
+
+    def status(self) -> dict:
+        """Per-job status snapshot + module totals.
+
+        ``jobs`` maps job id -> {state, weight, queue_depth, checkpoint,
+        error, and the per-job counters from utils.metrics.job_stats:
+        records, dispatches, edges, dispatch seconds, stall/skip counts,
+        queue-depth high-water}.  ``totals`` preserves the module
+        aggregates as sums (max for high-water marks).
+        """
+        with self._lock:
+            jobs = dict(self._jobs)
+            admitted = self._admitted_bytes
+        out = {}
+        for job_id, job in jobs.items():
+            out[job_id] = {
+                "state": job.state,
+                "weight": job.weight,
+                "queue_depth": job.queue_depth,
+                "state_bytes": job.state_bytes,
+                "edges_hint": job.edges_hint,
+                "checkpoint_path": job.checkpoint_path,
+                "error": repr(job.error) if job.error is not None else None,
+                **metrics.job_stats(job_id),
+            }
+        return {
+            "jobs": out,
+            "admitted_state_bytes": admitted,
+            "totals": metrics.job_totals(),
+        }
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted job is terminal (True) or the
+        timeout elapses (False)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            left = None if deadline is None else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                return False
+            if not job.wait(left):
+                return False
+        return True
+
+    def shutdown(self, cancel: bool = True, timeout: float = 60.0) -> None:
+        """Stop the scheduler.  ``cancel`` (default) cancels live jobs
+        first — their in-flight windows drain through the completion-queue
+        path; ``cancel=False`` waits for them to finish instead."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+        if cancel:
+            for job in jobs:
+                self.cancel(job, wait=False)
+        self.wait_all(timeout)
+        with self._lock:
+            self._stop = True
+            thread = self._thread
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout)
+
+    def __enter__(self) -> "JobManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(cancel=True)
+
+    # -- scheduler internals -------------------------------------------------
+
+    def _ensure_scheduler(self) -> None:
+        """Start the scheduler thread on first submit; caller holds _lock."""
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="gelly-job-scheduler", daemon=True
+                )
+                self._thread.start()
+
+    def _start_sink_thread(self, job: Job) -> None:
+        """Per-job sink pump: drains the bounded queue into the sink on its
+        own thread, so sink latency lands on this job alone."""
+
+        def pump():  # single-thread: per-job sink pump
+            while True:
+                rec = job._out.get()
+                if rec is _SENTINEL:
+                    break
+                t0 = time.perf_counter()
+                try:
+                    job.sink(rec)
+                except BaseException as e:
+                    self._fail(job, e)
+                    break
+                metrics.job_add(
+                    job.job_id,
+                    "job_sink_stall_s",
+                    time.perf_counter() - t0,
+                )
+                self._wake.set()  # queue space freed: the job is runnable
+            self._mark_drained(job)
+
+        job._sink_thread = threading.Thread(
+            target=pump, name=f"gelly-sink-{job.job_id}", daemon=True
+        )
+        job._sink_thread.start()
+
+    def _mark_drained(self, job: Job) -> None:
+        """DRAINING -> DONE once the job's sentinel was consumed (sink pump
+        or ``results``); no-op for FAILED/CANCELLED drains."""
+        with self._lock:
+            if job._state_in(JobState.DRAINING):
+                job._transition(JobState.DONE)
+                self._release(job)
+
+    def _release(self, job: Job) -> None:
+        """Return a terminal job's admitted bytes and drop its source
+        closure (which may capture the whole input dataset) so a retained
+        terminal job costs bookkeeping, not data; caller holds _lock."""
+        with self._lock:
+            self._admitted_bytes -= job.state_bytes
+            job.state_bytes = 0  # idempotent: released exactly once
+            job._build = None
+
+    def _fail(self, job: Job, err: BaseException) -> None:
+        """Mark FAILED from ANY thread (scheduler pull errors, sink pump
+        errors).  Sentinel delivery is DEFERRED to the scheduler — only the
+        scheduler thread ever puts into a job's queue, which is what makes
+        its full()-check-then-put_nowait in ``_run_quantum`` race-free."""
+        with self._lock:
+            if job._state_in(*JobState.TERMINAL):
+                return
+            job._error = err
+            job._transition(JobState.FAILED)
+            self._release(job)
+            job._sentinel_pending = True
+        self._wake.set()
+
+    def _enqueue_sentinel(self, job: Job) -> None:  # single-thread: scheduler
+        """Best-effort sentinel enqueue; a full queue defers it to the next
+        scheduler round (``_sentinel_pending``) rather than blocking."""
+        try:
+            job._out.put_nowait(_SENTINEL)
+            delivered = True
+        except queue.Full:
+            delivered = False
+        with self._lock:
+            job._sentinel_pending = not delivered
+        if not delivered:
+            self._wake.set()
+
+    # The scheduler loop and everything below it runs on the ONE scheduler
+    # thread; job lifecycle state is still read/written under the manager
+    # lock because API threads mutate it concurrently.
+
+    def _loop(self) -> None:  # single-thread: scheduler
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                jobs = list(self._jobs.values())
+            progressed = False
+            for job in jobs:
+                try:
+                    progressed |= self._run_quantum(job)
+                except BaseException as e:  # defensive: never kill the loop
+                    self._fail(job, e)
+            if not progressed:
+                # nothing runnable: park until a submit/resume/cancel or a
+                # consumer freeing queue space wakes us (short cap so a
+                # missed wake degrades to polling, never to a wedge)
+                self._wake.wait(0.05)
+                self._wake.clear()
+
+    def _run_quantum(self, job: Job) -> bool:  # single-thread: scheduler
+        """One weighted-fair round for one job; True if it made progress."""
+        with self._lock:
+            cancel_now = job._cancel_requested and not job._state_in(
+                *JobState.TERMINAL
+            )
+            sentinel_owed = job._sentinel_pending
+            if not cancel_now:
+                if job._state_in(JobState.PENDING):
+                    job._transition(JobState.RUNNING)
+                elif not job._state_in(JobState.RUNNING):
+                    # PAUSED / DRAINING / terminal: only a deferred
+                    # sentinel still needs delivering
+                    if sentinel_owed:
+                        self._enqueue_sentinel(job)
+                    return False
+        if cancel_now:
+            self._cancel_now(job)
+            return True
+        credits = job.weight * self.cfg.fair_quantum
+        pulled = 0
+        for _ in range(credits):
+            if not job._state_in(JobState.RUNNING):
+                break
+            if job._cancel_pending():
+                break
+            if job._out.full():
+                metrics.job_add(job.job_id, "job_queue_full_skips", 1)
+                break
+            if job._it is None:
+                build = job._build
+                if build is None:
+                    break  # raced a concurrent terminal transition
+                # lazy build: first schedule pays the query's setup
+                # (including any cold compile) on the scheduler thread —
+                # cooperative by design, and amortized by the shared cache
+                job._it = iter(build())
+            t0 = time.perf_counter()
+            try:
+                rec = next(job._it)
+            except StopIteration:
+                with self._lock:
+                    job._transition(JobState.DRAINING)
+                self._enqueue_sentinel(job)
+                pulled += 1
+                break
+            except BaseException as e:
+                self._fail(job, e)
+                pulled += 1
+                break
+            metrics.job_add(
+                job.job_id, "job_dispatch_s", time.perf_counter() - t0
+            )
+            metrics.job_add(job.job_id, "job_dispatches", 1)
+            metrics.job_add(job.job_id, "job_records", 1)
+            if job.edges_per_record:
+                metrics.job_add(job.job_id, "job_edges", job.edges_per_record)
+            # sole producer is this thread and fullness was checked above,
+            # so put_nowait cannot raise
+            job._out.put_nowait(rec)
+            metrics.job_high_water(
+                job.job_id, "job_queue_depth_hwm", job._out.qsize()
+            )
+            pulled += 1
+        if pulled:
+            metrics.job_add(job.job_id, "job_sched_rounds", 1)
+        return bool(pulled)
+
+    def _cancel_now(self, job: Job) -> None:  # single-thread: scheduler
+        """Perform a requested cancel on the scheduler thread.
+
+        Closing the iterator propagates GeneratorExit into the merge loop,
+        whose drain path waits on each in-flight fold and recycles its
+        transfer arenas (``# arena-live-until: drain`` — see
+        core/async_exec.py); then the job is marked CANCELLED and the
+        sentinel appended.  Emissions already in the queue stay DELIVERABLE:
+        they were emitted past their windows' checkpoint saves, so dropping
+        them would turn a cancel + resubmit-from-checkpoint into an
+        at-most-once gap (the runtime keeps the framework's state
+        exactly-once / emission at-least-once contract).
+        """
+        it = job._it
+        job._it = None
+        if it is not None and hasattr(it, "close"):
+            try:
+                it.close()
+            except BaseException as e:
+                # a close-time error must not mask the cancel; record it
+                with self._lock:
+                    if job._error is None:
+                        job._error = e
+        with self._lock:
+            if not job._state_in(*JobState.TERMINAL):
+                job._transition(JobState.CANCELLED)
+                self._release(job)
+        self._enqueue_sentinel(job)

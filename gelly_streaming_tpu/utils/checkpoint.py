@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any
 
 import jax
@@ -78,6 +79,20 @@ def _treedef_token(state: Any):
         "shapes": [list(np.shape(l)) for l in leaves],
         "dtypes": [str(np.asarray(l).dtype) for l in leaves],
     }
+
+
+def per_job_file(path: str, job_id: str) -> str:
+    """Per-job snapshot file under a shared checkpoint prefix.
+
+    The job runtime (runtime/manager.py) gives every submitted job an
+    INDEPENDENT positional checkpoint — two jobs crash-resume from their own
+    positions, never a merged one — by keying the shared prefix with the
+    job id, normalized so the .npz extension stays terminal and an id with
+    path separators cannot escape the checkpoint directory.
+    """
+    base = path[: -len(".npz")] if path.endswith(".npz") else path
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", str(job_id))
+    return f"{base}.job_{safe}.npz"
 
 
 def per_process_file(path: str) -> str:

@@ -237,6 +237,116 @@ def reset_comms_stats() -> None:
         _COMMS = _comms_zero()
 
 
+# ---------------------------------------------------------------------------
+# Per-job counter scoping (the multi-tenant job runtime, runtime/manager.py).
+# The scheduler thread, per-job sink threads, and status() readers all touch
+# these registries at once, so every access goes through _JOB_LOCK — the
+# lock-discipline analyzer pass enforces the annotations, and
+# tests/test_metrics_threads.py hammers concurrent-job isolation (no lost
+# updates within a job, no cross-job bleed between jobs).
+#
+# Module aggregates are preserved as SUMS: additive counters accumulate into
+# ``_JOB_TOTALS`` alongside the per-job dict, so ``job_totals()`` equals the
+# field-wise sum of ``all_job_stats()`` at any quiescent point.  High-water
+# marks aggregate as MAX (a sum of peak queue depths is not a meaningful
+# module figure).
+
+
+_JOB_LOCK = threading.Lock()
+
+
+def _job_zero() -> dict:
+    return {
+        # emissions delivered into the job's bounded output queue
+        "job_records": 0,
+        # iterator pulls the scheduler executed for this job (each pull
+        # dispatches that job's next window through the shared pipeline)
+        "job_dispatches": 0,
+        # edges attributed to this job (edges_per_record hint x records;
+        # 0 when the query's per-record edge count is unknown)
+        "job_edges": 0,
+        # wall seconds the scheduler spent inside this job's pulls
+        "job_dispatch_s": 0.0,
+        # wall seconds this job's sink spent consuming its records (sink
+        # pump thread only; sink-less jobs stay 0)
+        "job_sink_stall_s": 0.0,
+        # weighted-fair rounds in which this job made progress
+        "job_sched_rounds": 0,
+        # rounds the job was skipped because its output queue was full
+        # (the slow-sink isolation boundary doing its job)
+        "job_queue_full_skips": 0,
+        # deepest output-queue occupancy seen (sink lag indicator)
+        "job_queue_depth_hwm": 0,
+    }
+
+
+# job id -> counter dict; entries appear at first bump, not at submit
+_JOB_COUNTERS: dict = {}  # guarded-by: _JOB_LOCK
+_JOB_TOTALS = _job_zero()  # guarded-by: _JOB_LOCK
+
+
+def job_add(job_id: str, key: str, amount: float) -> None:
+    """Accumulate a per-job counter AND its module aggregate (thread-safe)."""
+    with _JOB_LOCK:
+        counters = _JOB_COUNTERS.get(job_id)
+        if counters is None:
+            counters = _JOB_COUNTERS[job_id] = _job_zero()
+        counters[key] += amount
+        _JOB_TOTALS[key] += amount
+
+
+def job_high_water(job_id: str, key: str, value: float) -> None:
+    """Raise a per-job high-water mark (module aggregate keeps the max)."""
+    with _JOB_LOCK:
+        counters = _JOB_COUNTERS.get(job_id)
+        if counters is None:
+            counters = _JOB_COUNTERS[job_id] = _job_zero()
+        if value > counters[key]:
+            counters[key] = value
+        if value > _JOB_TOTALS[key]:
+            _JOB_TOTALS[key] = value
+
+
+def job_stats(job_id: str) -> dict:
+    """One job's counters (zeros for a job that never bumped anything)."""
+    with _JOB_LOCK:
+        return dict(_JOB_COUNTERS.get(job_id) or _job_zero())
+
+
+def all_job_stats() -> dict:
+    """{job id -> counter dict} snapshot across every job seen."""
+    with _JOB_LOCK:
+        return {jid: dict(c) for jid, c in _JOB_COUNTERS.items()}
+
+
+def job_totals() -> dict:
+    """Module aggregates over all jobs: sums for counters, max for
+    high-water marks — reported by bench.py's multi_tenant sweep and
+    ``JobManager.status()`` next to the per-job breakdown."""
+    with _JOB_LOCK:
+        out = dict(_JOB_TOTALS)
+    out["job_dispatch_s"] = round(out["job_dispatch_s"], 4)
+    return out
+
+
+def drop_job_stats(job_id: str) -> None:
+    """Forget one job's per-job registry row (the JobManager calls this
+    when it evicts an old terminal job).  The module TOTALS keep the job's
+    contribution — aggregates stay sums over every job ever run, only the
+    per-job breakdown is bounded."""
+    with _JOB_LOCK:
+        _JOB_COUNTERS.pop(job_id, None)
+
+
+def reset_job_stats() -> None:
+    """Drop every per-job registry entry and zero the aggregates (call
+    before a measurement window, read ``all_job_stats`` after)."""
+    global _JOB_TOTALS
+    with _JOB_LOCK:
+        _JOB_COUNTERS.clear()
+        _JOB_TOTALS = _job_zero()
+
+
 def compile_cache_stats() -> dict:
     """Process-wide executable-cache counters (core/compile_cache.py):
     entry hits/misses, XLA compiles + compile wall time, steady-state

@@ -1,0 +1,26 @@
+"""Seeded bug: job lifecycle state mutated OUTSIDE the manager lock.
+
+The runtime fixture for the lock-discipline pass (runtime/job.py's
+discipline): a job's ``_state`` is '# guarded-by: _lock' (the MANAGER's
+lock, shared by reference), so a transition taken without it races the
+scheduler's state checks — a cancelled job can be re-marked RUNNING after
+the scheduler already closed its iterator.
+
+Expected findings: exactly two UNGUARDED — the unlocked read in the guard
+test and the unlocked write of the transition.  Analyzer input only —
+never imported.
+"""
+
+import threading
+
+
+class BadJob:
+    def __init__(self, manager_lock: threading.Lock):
+        self._lock = manager_lock
+        self._state = "PENDING"  # guarded-by: _lock
+
+    def to_running(self):
+        # BUG: check-then-act without the manager lock — a concurrent
+        # cancel() between the read and the write is silently overwritten
+        if self._state == "PENDING":
+            self._state = "RUNNING"

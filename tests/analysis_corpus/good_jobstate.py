@@ -1,0 +1,25 @@
+"""Lock-discipline corpus (clean): job state mutated under the manager lock.
+
+The runtime's pattern (runtime/job.py): every lifecycle transition — and
+every read that feeds one — happens inside ``with self._lock:`` where
+``_lock`` IS the manager's lock, so the scheduler thread and the API
+threads observe one total transition order.  Analyzer input only — never
+imported.
+"""
+
+import threading
+
+
+class GoodJob:
+    def __init__(self, manager_lock: threading.Lock):
+        self._lock = manager_lock
+        self._state = "PENDING"  # guarded-by: _lock
+
+    def to_running(self):
+        with self._lock:
+            if self._state == "PENDING":
+                self._state = "RUNNING"
+
+    def snapshot(self) -> str:
+        with self._lock:
+            return self._state

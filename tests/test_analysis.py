@@ -49,7 +49,7 @@ def _package_paths():
     root = analysis.package_root()
     return [
         os.path.join(root, d)
-        for d in ("core", "io", "library", "parallel", "utils")
+        for d in ("core", "io", "library", "parallel", "runtime", "utils")
     ]
 
 
@@ -82,6 +82,7 @@ def test_cli_package_scan_exits_zero():
             "io",
             "library",
             "parallel",
+            "runtime",
         ],
         capture_output=True,
         text=True,
@@ -138,6 +139,17 @@ def test_corpus_unguarded():
     assert "_COUNT" in findings[0].message
     assert "self.total" in findings[1].message
     assert _analyze("good_unguarded.py") == []
+
+
+def test_corpus_jobstate():
+    """The runtime fixtures (ISSUE 5): job lifecycle state is
+    '# guarded-by:' the manager lock; a transition outside it is exactly
+    the lost-transition race the JobManager's discipline forbids."""
+    findings = _analyze("bad_jobstate.py")
+    assert _codes(findings) == ["UNGUARDED", "UNGUARDED"]
+    assert all("self._state" in f.message for f in findings)
+    assert all("_lock" in f.message for f in findings)
+    assert _analyze("good_jobstate.py") == []
 
 
 def test_corpus_traceif():

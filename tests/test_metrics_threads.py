@@ -65,6 +65,66 @@ def test_pipeline_counters_no_lost_updates():
 
 
 @pytest.mark.timeout_cap(120)
+def test_job_counters_no_lost_updates_no_cross_job_bleed():
+    """The job runtime's per-job registries (ISSUE 5): THREADS workers bump
+    TWO jobs' counters concurrently — each job's count must be exact (no
+    lost updates) and exactly its own (no bleed between job ids), with the
+    module totals preserved as the sum."""
+    metrics.reset_job_stats()
+    try:
+        flip = [0]
+        flip_lock = threading.Lock()
+
+        def bump():
+            with flip_lock:
+                flip[0] += 1
+                jid = "job-a" if flip[0] % 2 else "job-b"
+            metrics.job_add(jid, "job_records", 1)
+            metrics.job_add(jid, "job_edges", 3)
+
+        _hammer(bump)
+        total = THREADS * ITERS
+        a = metrics.job_stats("job-a")
+        b = metrics.job_stats("job-b")
+        assert a["job_records"] + b["job_records"] == total
+        assert a["job_records"] == total // 2 + (total % 2)
+        assert b["job_records"] == total // 2
+        assert a["job_edges"] == 3 * a["job_records"]
+        assert b["job_edges"] == 3 * b["job_records"]
+        totals = metrics.job_totals()
+        assert totals["job_records"] == total
+        assert totals["job_edges"] == 3 * total
+    finally:
+        metrics.reset_job_stats()
+
+
+@pytest.mark.timeout_cap(120)
+def test_job_high_water_is_per_job_max_under_contention():
+    metrics.reset_job_stats()
+    try:
+        values = list(range(THREADS * ITERS))
+        it_lock = threading.Lock()
+
+        def bump():
+            with it_lock:
+                v = values.pop()
+            # odd values to one job, even to the other: each registry must
+            # keep ITS OWN max, the module aggregate the global max
+            metrics.job_high_water(
+                "hwm-odd" if v % 2 else "hwm-even", "job_queue_depth_hwm", v
+            )
+
+        _hammer(bump)
+        top = THREADS * ITERS - 1
+        odd = metrics.job_stats("hwm-odd")["job_queue_depth_hwm"]
+        even = metrics.job_stats("hwm-even")["job_queue_depth_hwm"]
+        assert {odd, even} == {top, top - 1}
+        assert metrics.job_totals()["job_queue_depth_hwm"] == top
+    finally:
+        metrics.reset_job_stats()
+
+
+@pytest.mark.timeout_cap(120)
 def test_pipeline_high_water_is_max_under_contention():
     metrics.reset_pipeline_stats()
     try:
