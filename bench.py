@@ -721,6 +721,51 @@ def _ingest_scaling(src, dst, capacity: int, sample: int, batch: int):
         if path:
             os.unlink(path)
 
+    # ---- propagation-blocking pack + compressed wire bytes (ISSUE 6) ------
+    # Measured on a skewed, community-clustered sample — the workload the
+    # destination-binned delta/varint format exists for (uniform-random
+    # endpoints have no locality for deltas to exploit).  Pure host, like
+    # the rest of this sub-benchmark: sort+encode rate by worker count plus
+    # the shipped bytes/edge against the plain fixed-width pack and the raw
+    # 8 B/edge int32 columns.
+    from gelly_streaming_tpu.utils import metrics as _metrics
+
+    sk_s, sk_d = _skewed_sample(np.random.default_rng(6), sample, capacity)
+    # small smoke runs can have sample < batch: shrink the BDV batch rather
+    # than skipping (n_bdv of 0 would have no rows to measure)
+    bdv_batch = max(min(batch, sample), 1)
+    n_bdv = max(sample // bdv_batch, 1)
+    binned_pack_eps = {}
+    comp_bytes = 0
+    # pack_bdv_group bumps the process-global bin-occupancy high-water;
+    # this synthetic hub-heavy sample must not masquerade as drive skew in
+    # the headline JSON, so snapshot/restore around the measurement
+    wire_base = _metrics.wire_stats()
+    try:
+        for w in counts:
+            t0 = time.perf_counter()
+            arena = ingest.pack_bdv_group(
+                sk_s, sk_d, 0, n_bdv, bdv_batch, capacity, workers=w
+            )
+            binned_pack_eps[str(w)] = round(
+                (n_bdv * bdv_batch) / (time.perf_counter() - t0), 1
+            )
+            del arena
+        # per-batch shipped bytes (no group-max padding): the fast path's
+        # figure
+        comp_bytes = sum(
+            wire.pack_edges_bdv(
+                sk_s[i * bdv_batch : (i + 1) * bdv_batch],
+                sk_d[i * bdv_batch : (i + 1) * bdv_batch],
+                capacity,
+            ).nbytes
+            for i in range(n_bdv)
+        )
+    finally:
+        _restore_wire_stats(_metrics, wire_base)
+    plain_bpe = wire.wire_nbytes(bdv_batch, width) / bdv_batch
+    comp_bpe = comp_bytes / (n_bdv * bdv_batch)
+
     best = max((k for k in pack_eps if int(k) >= 4), key=int)
     return {
         "ingest_workers_available": cores,
@@ -732,6 +777,118 @@ def _ingest_scaling(src, dst, capacity: int, sample: int, batch: int):
         "ingest_parse_speedup_at_4plus": round(
             parse_eps[best] / parse_eps["1"], 2
         ),
+        "binned_pack_eps_by_workers": binned_pack_eps,
+        "binned_pack_eps": max(binned_pack_eps.values()),
+        "bytes_per_edge": {
+            "raw": 8.0,
+            "plain": round(plain_bpe, 3),
+            "compressed": round(comp_bpe, 3),
+        },
+        "wire_compress_ratio_vs_raw": round(8.0 / comp_bpe, 2),
+        "wire_compress_ratio_vs_plain": round(plain_bpe / comp_bpe, 2),
+    }
+
+
+def _restore_wire_stats(_metrics, base: dict) -> None:
+    """Reset the process-global wire counters back to a ``wire_stats()``
+    snapshot — sub-benchmarks measure through the shared registry but must
+    not leak their synthetic traffic into the headline drive's figures."""
+    _metrics.reset_wire_stats()
+    _metrics.wire_record_batch(
+        base["wire_batches"], base["wire_edges_total"], base["wire_bytes_total"]
+    )
+    _metrics.wire_high_water(
+        "wire_bin_occupancy_hwm", base["wire_bin_occupancy_hwm"]
+    )
+
+
+def _skewed_sample(rng, n: int, capacity: int):
+    """Community-clustered, hub-heavy edges: the propagation-blocking target
+    workload (real graphs have locality; uniform-random ids are the
+    adversarial case for any delta format)."""
+    comm = max(capacity >> 14, 64)
+    cbase = ((capacity * rng.random(n) ** 2).astype(np.int64) // comm) * comm
+    s = cbase + (comm * rng.random(n) ** 2).astype(np.int64)
+    d = cbase + (comm * rng.random(n) ** 4).astype(np.int64)
+    return (s % capacity).astype(np.int32), (d % capacity).astype(np.int32)
+
+
+def _binned_wire_bench(num_edges: int, capacity: int, batch: int):
+    """Binned+compressed ingest on vs off through the REAL wire fast path
+    (ISSUE 6 acceptance): same skewed sample, same descriptor, bit-identical
+    emissions; reports measured edges/s both ways plus the byte economy.
+
+    On this CPU image the device fold is scatter-OVERHEAD-bound (XLA CPU
+    scatters cost ~200 ns/update however local), so the measured speedup
+    here understates the binned format; the link-bound figure
+    (``wire_link_bound_speedup`` — bytes_plain / bytes_compressed, the
+    exact factor a byte-limited link gains) is what the tunnel-throttled
+    real-chip regime sees (BENCH_r05 last_real_chip_run).
+    """
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.degree_distribution import (
+        DegreeDistributionSummary,
+    )
+    from gelly_streaming_tpu.utils import metrics as _metrics
+
+    src, dst = _skewed_sample(np.random.default_rng(6), num_edges, capacity)
+
+    # the per-run measurements below reset the process-global wire counters;
+    # snapshot what the drive accumulated so far and restore it on the way
+    # out, so the headline JSON's cumulative wire_stats stay cumulative
+    base = _metrics.wire_stats()
+
+    def run(**kw):
+        cfg = StreamConfig(vertex_capacity=capacity, batch_size=batch, **kw)
+
+        def once():
+            return list(
+                DegreeDistributionSummary().run(
+                    EdgeStream.from_arrays(src, dst, cfg)
+                )
+            )
+
+        once()  # compile warmup
+        _metrics.reset_wire_stats()
+        t0 = time.perf_counter()
+        recs = once()
+        dt = time.perf_counter() - t0
+        return num_edges / dt, _metrics.wire_stats(), recs
+
+    # "off" = the plain fixed-width arrival-order layout — the ISSUE's
+    # uncompressed equivalence oracle (auto mode may pick EF40 on multi-core
+    # hosts, which is itself a compressed format; the explicit 0s pin the
+    # baseline against ambient GELLY_BINNED_INGEST/GELLY_WIRE_COMPRESS env,
+    # which would otherwise silently compress the "off" run too)
+    try:
+        plain_eps, plain_w, plain_recs = run(
+            wire_encoding="plain", binned_ingest=0, wire_compress=0
+        )
+        comp_eps, comp_w, comp_recs = run(wire_compress=1)
+    finally:
+        _restore_wire_stats(_metrics, base)
+    equal = len(plain_recs) == len(comp_recs) and all(
+        np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        for a, b in zip(plain_recs, comp_recs)
+    )
+    return {
+        "plain_wire_eps": round(plain_eps, 1),
+        "compressed_wire_eps": round(comp_eps, 1),
+        "binned_wire_speedup": round(comp_eps / plain_eps, 2),
+        "wire_bytes_per_edge_plain": plain_w["wire_bytes_per_edge"],
+        "wire_bytes_per_edge_compressed": comp_w["wire_bytes_per_edge"],
+        "wire_link_bound_speedup": round(
+            plain_w["wire_bytes_per_edge"]
+            / max(comp_w["wire_bytes_per_edge"], 1e-9),
+            2,
+        ),
+        "binned_emissions_equal": equal,
+        # sub-bench-scoped key: the headline "wire_bin_occupancy_hwm" is the
+        # DRIVE's figure (this synthetic sample must neither leak into a
+        # partial JSON under that name nor clobber/get clobbered by the
+        # final wire_stats spread)
+        "binned_bench_bin_occupancy_hwm": comp_w["wire_bin_occupancy_hwm"],
     }
 
 
@@ -948,6 +1105,35 @@ def main():
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"async window bench skipped: {e}", file=sys.stderr)
 
+    # ---- binned + compressed ingest: on vs off through the fast path -------
+    # (ISSUE 6 acceptance: skewed sample, bit-identical emissions, measured
+    # eps both ways, bytes/edge economy + the link-bound factor)
+    binned_stats = {}
+    try:
+        if os.environ.get("GELLY_BENCH_BINNED", "1") != "0":
+            binned_stats = _binned_wire_bench(
+                num_edges=int(
+                    os.environ.get("GELLY_BENCH_BINNED_EDGES", 1 << 21)
+                ),
+                capacity=min(capacity, 1 << 20),
+                batch=min(batch, 1 << 18),
+            )
+            _PARTIAL.update(binned_stats)
+            print(
+                f"binned ingest: plain "
+                f"{binned_stats['plain_wire_eps'] / 1e6:.2f}M eps at "
+                f"{binned_stats['wire_bytes_per_edge_plain']} B/e vs "
+                f"binned+compressed "
+                f"{binned_stats['compressed_wire_eps'] / 1e6:.2f}M eps at "
+                f"{binned_stats['wire_bytes_per_edge_compressed']} B/e "
+                f"(measured x{binned_stats['binned_wire_speedup']}, "
+                f"link-bound x{binned_stats['wire_link_bound_speedup']}), "
+                f"emissions equal: {binned_stats['binned_emissions_equal']}",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"binned ingest bench skipped: {e}", file=sys.stderr)
+
     # ---- multi-tenant job runtime: jobs in {1, 2, 4} over one pipeline -----
     # (ISSUE 5 acceptance: 4 same-shape jobs at >= 0.8x the single-job
     # baseline with 0 recompiles after warmup and near-1.0 fairness)
@@ -984,6 +1170,11 @@ def main():
 
     comms_stats = _metrics.comms_stats()
     _PARTIAL.update(comms_stats)
+    # wire-path transfer accounting (binned + compressed ingest, ISSUE 6):
+    # cumulative over every wire stream the drive shipped; _PARTIAL-safe
+    # (pure host counters, readable even when the device never came up)
+    wire_stats = _metrics.wire_stats()
+    _PARTIAL.update(wire_stats)
 
     analysis_stats = {}
     try:
@@ -1425,8 +1616,12 @@ def main():
                 **ingest_stats,
                 **cache_guard,
                 **async_stats,
+                **binned_stats,
                 **analysis_stats,
                 **comms_stats,
+                # re-read at exit: the headline drive's wire streams ship
+                # after the mid-drive snapshot above
+                **_metrics.wire_stats(),
             }
         )
     )

@@ -44,10 +44,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--paths",
         nargs="+",
-        default=["core", "io", "library", "parallel", "runtime"],
+        default=["core", "io", "library", "ops", "parallel", "runtime"],
         help="files/directories to scan; bare names resolve inside the "
-        "gelly_streaming_tpu package (default: core io library parallel "
-        "runtime)",
+        "gelly_streaming_tpu package (default: core io library ops "
+        "parallel runtime)",
     )
     parser.add_argument(
         "--select",

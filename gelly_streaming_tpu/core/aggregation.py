@@ -368,6 +368,79 @@ class SummaryAggregation:
             return (wire.EF40, cfg.vertex_capacity)
         return wire.width_for_capacity(cfg.vertex_capacity)
 
+    def _binned_modes(self, cfg: StreamConfig):
+        """Resolve the propagation-blocking ingest switches for this
+        descriptor: ``(binned, compress)``.
+
+        Binning/compression reorder each batch into a (dst, src)-sorted
+        multiset, so they are legal only for ORDER-FREE folds: an explicit
+        ``cfg.binned_ingest=1`` / ``cfg.wire_compress=1`` on an
+        order-sensitive descriptor refuses loudly (the EF40 rule), while
+        the ambient env switches quietly stay on the arrival-order oracle —
+        a process-wide GELLY_WIRE_COMPRESS=1 must not break the one
+        order-sensitive query in a mixed pipeline.  Compression further
+        needs ids in 2^28 (BDV varint bound) and yields to an explicit
+        ``wire_encoding='ef40'`` (two compressed encodings cannot both win).
+        """
+        from gelly_streaming_tpu.io import wire
+
+        compress = wire.resolve_wire_compress(cfg)
+        binned = wire.resolve_binned_ingest(cfg)
+        if not (binned or compress):
+            return False, False
+        forced = cfg.binned_ingest == 1 or cfg.wire_compress == 1
+        if not self.order_free:
+            if forced:
+                raise ValueError(
+                    "binned/compressed ingest ships a (dst, src)-sorted "
+                    "multiset; this aggregation is not order-free"
+                )
+            return False, False
+        if compress and cfg.vertex_capacity > 1 << wire.BDV_MAX_ID_BITS:
+            if cfg.wire_compress == 1:
+                raise ValueError(
+                    "wire_compress needs vertex_capacity <= 2^28 (BDV varints)"
+                )
+            compress = False
+        if compress and cfg.wire_encoding == "ef40":
+            if cfg.wire_compress == 1:
+                raise ValueError(
+                    "wire_compress and wire_encoding='ef40' are mutually "
+                    "exclusive wire formats; pick one"
+                )
+            compress = False
+        return binned, compress
+
+    def _maybe_bin_pane(
+        self, cfg: StreamConfig, pane: WindowPane, width=None
+    ) -> WindowPane:
+        """Destination-bin a closed pane when binned ingest resolves on.
+
+        Returns the pane with its edges (dst, src)-sorted — the same
+        multiset, so order-free folds emit identically while their scatters
+        walk the summary arrays segment-locally (the cache half of
+        propagation blocking).  Valued/timed panes pass through untouched
+        (their payload alignment is not worth permuting on the pack
+        thread), as do non-order-free descriptors (loudly when forced —
+        see ``_binned_modes``).  Callers that pack the pane at a known wire
+        ``width`` pass it: tuple encodings (EF40) regroup each row by src
+        themselves, so the pre-sort would be pure wasted pack-thread work
+        (the same skip the wire fast path and the mesh row packer apply).
+        """
+        if pane.val is not None or pane.time is not None or pane.num_edges <= 1:
+            return pane
+        if width is not None and isinstance(width, tuple):
+            return pane
+        binned, _compress = self._binned_modes(cfg)
+        if not binned:
+            return pane
+        from gelly_streaming_tpu.io import wire
+
+        s, d = wire.sort_edges_binned(
+            pane.src, pane.dst, cfg.vertex_capacity, record_stats=True
+        )
+        return pane._replace(src=s, dst=d)
+
     def _wire_checkpoint_like(self, stream):
         """Wire-path snapshot layout: the FULL fold carry (stage states +
         summary — closing the reference's unsaved-operator-state gap,
@@ -448,22 +521,36 @@ class SummaryAggregation:
         amortized to well under a percent of stream time on a PCIe host.
         """
         from gelly_streaming_tpu.io import wire
+        from gelly_streaming_tpu.utils import metrics
 
         cfg = stream.cfg
         packed = getattr(stream, "_wire_packed", None)
         if packed is not None:
-            # replay source: buffers are already wire-format; the loop's only
-            # host cost is the transfer itself
+            # replay source: buffers are already wire-format (the producer
+            # chose the encoding, BDV included); the loop's only host cost
+            # is the transfer itself
             bufs, batch, width, tail_pair = packed
-            # (EF40 x order-sensitive refusal happens in run(), which guards
-            # every consumption path, not just this one)
+            # (EF40/BDV x order-sensitive refusal happens in run(), which
+            # guards every consumption path, not just this one)
             src = dst = None
+            binned = compress = False
             n_full = len(bufs)
             total_edges = n_full * batch + (len(tail_pair[0]) if tail_pair else 0)
         else:
             src, dst, batch = stream._wire_arrays
             batch = min(batch, max(len(src), 1))
-            width = self._wire_width(cfg, batch)
+            binned, compress = self._binned_modes(cfg)
+            if compress:
+                # the compressed wire format: (dst, src)-binned batches ship
+                # delta/varint-packed and decode on device inside the same
+                # cached fold executable (ops/wire_decode.py)
+                width = (wire.BDV, cfg.vertex_capacity)
+            else:
+                width = self._wire_width(cfg, batch)
+                if binned and isinstance(width, tuple):
+                    # EF40 regroups each batch by src itself — pre-sorting
+                    # by dst would be re-shuffled away; skip the wasted pass
+                    binned = False
             n_full = len(src) // batch
             rem = len(src) - n_full * batch
             tail_pair = (
@@ -614,30 +701,86 @@ class SummaryAggregation:
                 def prep(item):
                     o, g = item
                     if g == 1:
-                        return 1, bufs[start_batch + o]
-                    return g, np.stack(bufs[start_batch + o : start_batch + o + g])
+                        buf = bufs[start_batch + o]
+                        metrics.wire_record_batch(1, batch, buf.nbytes)
+                        return 1, buf
+                    group_bufs = bufs[start_batch + o : start_batch + o + g]
+                    widest = max(b.nbytes for b in group_bufs)
+                    if all(b.nbytes == widest for b in group_bufs):
+                        arena = np.stack(group_bufs)
+                    else:
+                        # variable-size (BDV) replay buffers: pad to the
+                        # group max — trailing zeros decode as dropped
+                        # empty varint groups
+                        arena = np.zeros((g, widest), np.uint8)
+                        for j, b in enumerate(group_bufs):
+                            arena[j, : b.nbytes] = b
+                    metrics.wire_record_batch(g, g * batch, arena.nbytes)
+                    return g, arena
 
             else:
                 from gelly_streaming_tpu.io import ingest as ingest_mod
 
                 workers = ingest_mod.resolve_workers(cfg.ingest_workers)
-                nbytes = wire.wire_nbytes(batch, width)
+                nbytes = wire.wire_nbytes(batch, width) if not compress else 0
 
                 def prep(item):
                     o, g = item
                     i0 = start_batch + o
+                    if compress:
+                        # bin + delta/varint pack (sort on this pack thread,
+                        # group rows across the ingest pool); buffers bucket
+                        # to stable shapes, so same-regime batches reuse one
+                        # compiled decode+fold executable
+                        if g == 1:
+                            buf = wire.pack_edges_bdv(
+                                src[i0 * batch : (i0 + 1) * batch],
+                                dst[i0 * batch : (i0 + 1) * batch],
+                                cfg.vertex_capacity,
+                                record_stats=True,
+                            )
+                        else:
+                            buf = ingest_mod.pack_bdv_group(
+                                src,
+                                dst,
+                                i0,
+                                g,
+                                batch,
+                                cfg.vertex_capacity,
+                                workers,
+                            )
+                        metrics.wire_record_batch(g, g * batch, buf.nbytes)
+                        return g, buf
                     if g == 1:
-                        return 1, wire.pack_edges(
-                            src[i0 * batch : (i0 + 1) * batch],
-                            dst[i0 * batch : (i0 + 1) * batch],
-                            width,
-                        )
+                        s_b = src[i0 * batch : (i0 + 1) * batch]
+                        d_b = dst[i0 * batch : (i0 + 1) * batch]
+                        if binned:
+                            s_b, d_b = wire.sort_edges_binned(
+                                s_b, d_b, cfg.vertex_capacity, record_stats=True
+                            )
+                        buf = wire.pack_edges(s_b, d_b, width)
+                        metrics.wire_record_batch(1, batch, buf.nbytes)
+                        return 1, buf
                     # pack straight into the group arena (the transfer
                     # layout): no re-copy between pack and device_put
                     arena = np.empty((g, nbytes), np.uint8)
-                    ingest_mod.pack_rows_into(
-                        src, dst, i0, g, batch, width, arena, workers
-                    )
+                    if binned:
+                        ingest_mod.pack_binned_rows_into(
+                            src,
+                            dst,
+                            i0,
+                            g,
+                            batch,
+                            width,
+                            cfg.vertex_capacity,
+                            arena,
+                            workers,
+                        )
+                    else:
+                        ingest_mod.pack_rows_into(
+                            src, dst, i0, g, batch, width, arena, workers
+                        )
+                    metrics.wire_record_batch(g, g * batch, arena.nbytes)
                     return g, arena
 
             with wire.Prefetcher(offsets, prep, depth=cfg.prefetch_depth) as pf:
@@ -754,12 +897,13 @@ class SummaryAggregation:
             )
         packed = getattr(stream, "_wire_packed", None)
         if packed is not None and isinstance(packed[2], tuple) and not self.order_free:
-            # EF40 replay buffers carry per-batch sorted multisets; EVERY
-            # consumption path (fast, mesh, simulated) would see reordered
-            # edges, so refuse up front rather than only on the fast path
+            # EF40/BDV replay buffers carry per-batch sorted multisets;
+            # EVERY consumption path (fast, mesh, simulated) would see
+            # reordered edges, so refuse up front rather than only on the
+            # fast path
             raise ValueError(
-                "EF40 replay buffers carry a sorted multiset; this "
-                "aggregation is not order-free"
+                f"{packed[2][0]} replay buffers carry a sorted multiset; "
+                "this aggregation is not order-free"
             )
         if self._wire_eligible(stream):
             return OutputStream(
@@ -816,6 +960,10 @@ class SummaryAggregation:
             )
 
         def fold_pane(pane: WindowPane):
+            # destination-bin the pane first (order-free folds only; no-op
+            # otherwise): the round-robin strided slices of a sorted pane
+            # stay sorted, so each partition's scatter is segment-local
+            pane = self._maybe_bin_pane(cfg, pane)
             partials = []
             for part in range(n_parts):
                 # Round-robin partitioning of the pane stands in for the
@@ -943,6 +1091,9 @@ class SummaryAggregation:
             n = pane.num_edges
             if already or n == 0:
                 return (pane, None), None
+            # destination binning rides this pack thread too (order-free
+            # folds only; no-op otherwise) — the dispatch loop never sorts
+            pane = self._maybe_bin_pane(cfg, pane)
             padded = max(1, 1 << (n - 1).bit_length())
             src = pool.acquire((padded,), np.int32)
             dst = pool.acquire((padded,), np.int32)
@@ -1039,7 +1190,7 @@ class SummaryAggregation:
 
         cfg = stream.cfg
         live = (
-            p
+            self._maybe_bin_pane(cfg, p)
             for p in stream_panes(stream, window_ms)
             if not (
                 (0 <= p.window_id <= skip_through)
@@ -1562,14 +1713,24 @@ class MeshAggregationRunner:
         row_len = max(1, min(batch, max(total, 1)) // S)
         width = self.agg._wire_width(cfg, row_len)
         n_rows = -(-total // row_len) if total else 0
+        binned, _compress = self.agg._binned_modes(cfg)
+        if binned and isinstance(width, tuple):
+            binned = False  # EF40 regroups by src itself; skip the dst sort
 
         def row(i):
-            return self._pack_padded_row(
-                src[i * row_len : (i + 1) * row_len],
-                dst[i * row_len : (i + 1) * row_len],
-                row_len,
-                width,
-            )
+            s_b = src[i * row_len : (i + 1) * row_len]
+            d_b = dst[i * row_len : (i + 1) * row_len]
+            if binned:
+                # destination-binned mesh rows: each shard's streaming fold
+                # scatters a sorted segment (order-free folds only — the
+                # multiset per row is unchanged, so the stream-end collective
+                # merge is bit-identical)
+                from gelly_streaming_tpu.io import wire as wire_mod
+
+                s_b, d_b = wire_mod.sort_edges_binned(
+                    s_b, d_b, cfg.vertex_capacity, record_stats=True
+                )
+            return self._pack_padded_row(s_b, d_b, row_len, width)
 
         return row, n_rows, row_len, width, total
 
@@ -1983,19 +2144,24 @@ class MeshAggregationRunner:
             )
 
         def prepare(g: int):
-            rows = np.empty((S, wire_mod.wire_nbytes(row_len, width)), np.uint8)
+            # zeros, not empty: BDV replay rows are variable-size payloads
+            # padded into the max-width arena (trailing zeros decode as
+            # dropped empty varint groups); fixed-width rows fill exactly
+            rows = np.zeros((S, wire_mod.wire_nbytes(row_len, width)), np.uint8)
             counts = np.zeros((S,), np.int32)
             for s in range(S):
                 i = g * S + s
                 if i < n_rows:
-                    rows[s], counts[s] = row(i)
+                    buf, counts[s] = row(i)
                 else:
-                    rows[s], _ = self._pack_padded_row(
+                    buf, _ = self._pack_padded_row(
                         np.empty((0,), np.int32),
                         np.empty((0,), np.int32),
                         row_len,
                         width,
                     )
+                rows[s, : buf.nbytes] = buf
+            metrics.wire_record_batch(S, int(counts.sum()), rows.nbytes)
             return g, (rows, counts)
 
         since_snap = 0
@@ -2054,6 +2220,7 @@ class MeshAggregationRunner:
         from jax.sharding import PartitionSpec as P
 
         from gelly_streaming_tpu.io import wire as wire_mod
+        from gelly_streaming_tpu.utils import metrics
         from gelly_streaming_tpu.utils.checkpoint import (
             checkpoint_exists,
             load_state,
@@ -2153,19 +2320,24 @@ class MeshAggregationRunner:
             )
 
         def prepare(g: int):
-            rows = np.empty((S, wire_mod.wire_nbytes(row_len, width)), np.uint8)
+            # zeros, not empty: BDV replay rows are variable-size payloads
+            # padded into the max-width arena (trailing zeros decode as
+            # dropped empty varint groups); fixed-width rows fill exactly
+            rows = np.zeros((S, wire_mod.wire_nbytes(row_len, width)), np.uint8)
             counts = np.zeros((S,), np.int32)
             for s in range(S):
                 i = g * S + s
                 if i < n_rows:
-                    rows[s], counts[s] = row(i)
+                    buf, counts[s] = row(i)
                 else:
-                    rows[s], _ = self._pack_padded_row(
+                    buf, _ = self._pack_padded_row(
                         np.empty((0,), np.int32),
                         np.empty((0,), np.int32),
                         row_len,
                         width,
                     )
+                rows[s, : buf.nbytes] = buf
+            metrics.wire_record_batch(S, int(counts.sum()), rows.nbytes)
             return g, (rows, counts)
 
         since_snap = 0
@@ -2393,24 +2565,42 @@ class MeshAggregationRunner:
             cfg, checkpoint_path, restore
         )
 
+        binned_on, _ = agg._binned_modes(cfg)
+
         def prepare(pane: WindowPane):
             """Pack-thread routing + packing (keyBy off the dispatch thread):
             value-less panes become packed per-shard wire rows — owner
             buckets under ``spec.route_key``, round-robin otherwise — and
-            valued panes ship raw bucket arrays."""
+            valued panes ship raw bucket arrays.  With binned ingest on,
+            the pane is destination-sorted first (order-free folds see the
+            same multiset; per-shard scatters turn segment-local) and the
+            keyBy bucketing itself runs on the parallel ingest pool — the
+            host_route work moved into the parse/pack pass."""
             already = (0 <= pane.window_id <= skip_through) or (
                 pane.window_id == -1 and skip_global
             )
             if already or len(pane.src) == 0:
                 return (pane, None, None), None
+            pane = agg._maybe_bin_pane(cfg, pane, width)
             if pane.val is None:
                 if spec.route_key:
-                    routed = host_route(
-                        pane.src.astype(np.int32),
-                        pane.dst.astype(np.int32),
-                        S,
-                        key=spec.route_key,
-                    )
+                    if binned_on:
+                        from gelly_streaming_tpu.io import ingest as ingest_mod
+
+                        routed = ingest_mod.parallel_host_route(
+                            pane.src.astype(np.int32),
+                            pane.dst.astype(np.int32),
+                            S,
+                            key=spec.route_key,
+                            workers=cfg.ingest_workers,
+                        )
+                    else:
+                        routed = host_route(
+                            pane.src.astype(np.int32),
+                            pane.dst.astype(np.int32),
+                            S,
+                            key=spec.route_key,
+                        )
                     counts = routed.mask.sum(axis=1).astype(np.int32)
                     rows = wire_mod.pack_bucket_rows(
                         routed.src, routed.dst, counts, width
@@ -2562,6 +2752,10 @@ class MeshAggregationRunner:
             )
             if already or len(pane.src) == 0:
                 return (pane, None, None), None
+            # destination binning (order-free folds; no-op otherwise): the
+            # round-robin strided slices of a sorted pane stay sorted, so
+            # each shard's fold scatter is segment-local
+            pane = agg._maybe_bin_pane(cfg, pane, width)
             if pane.val is None:
                 rows, counts, cap = self._pack_pane_wire(pane, width)
                 return (pane, "wire", cap), (rows, counts)

@@ -98,6 +98,22 @@ class StreamConfig:
     # supply a ShardedStateSpec.  Descriptors without a spec always use the
     # replicated combine regardless of this knob.
     sharded_state: int = -1
+    # Propagation-blocking ingest (io/wire.py BDV, ops/wire_decode.py).
+    # binned_ingest: bin/sort each value-less micro-batch or pane by
+    # (dst, src) before packing, so device folds scatter segment-locally
+    # (cache-resident summary rows instead of random [C] misses) and the
+    # sharded pane plane's host keyBy runs on the parallel ingest pool.
+    # Legal only for ORDER-FREE aggregations (the fold sees the same
+    # multiset); order-sensitive consumers refuse a forced 1.  1 = on,
+    # 0 = off (the arrival-order oracle), -1 = defer to the
+    # GELLY_BINNED_INGEST env var (default off).
+    binned_ingest: int = -1
+    # wire_compress: ship binned batches delta/varint-compressed (BDV:
+    # sorted dst deltas + run-relative src, decoded on device inside the
+    # same cached fold executable).  Implies binned_ingest; needs
+    # vertex_capacity <= 2^28.  1 = on, 0 = off (the plain fixed-width
+    # oracle), -1 = defer to GELLY_WIRE_COMPRESS (default off).
+    wire_compress: int = -1
     # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
     # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
     # values trail the watermark behind max seen time by the bound, holding
@@ -137,6 +153,23 @@ class StreamConfig:
             raise ValueError("async_windows must be >= 0")
         if self.sharded_state not in (-1, 0, 1):
             raise ValueError("sharded_state must be -1 (auto), 0, or 1")
+        if self.binned_ingest not in (-1, 0, 1):
+            raise ValueError("binned_ingest must be -1 (auto), 0, or 1")
+        if self.wire_compress not in (-1, 0, 1):
+            raise ValueError("wire_compress must be -1 (auto), 0, or 1")
+        if self.wire_compress == 1 and self.binned_ingest == 0:
+            raise ValueError(
+                "wire_compress=1 needs binned batches (delta encoding rides "
+                "the sorted bins); don't force binned_ingest=0 with it"
+            )
+        if self.wire_compress == 1:
+            from gelly_streaming_tpu.io.wire import BDV_MAX_ID_BITS
+
+            if self.vertex_capacity > 1 << BDV_MAX_ID_BITS:
+                raise ValueError(
+                    f"wire_compress needs vertex_capacity <= "
+                    f"2^{BDV_MAX_ID_BITS} (BDV varints)"
+                )
         if self.vertex_capacity <= 0:
             raise ValueError("vertex_capacity must be positive")
         if self.num_shards <= 0:

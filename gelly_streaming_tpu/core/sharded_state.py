@@ -38,10 +38,10 @@ union-find and additive summaries this plane serves.
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple, Optional
 
 from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
+from gelly_streaming_tpu.utils.envswitch import resolve_switch
 
 
 def resolve_sharded_state(cfg) -> bool:
@@ -51,23 +51,9 @@ def resolve_sharded_state(cfg) -> bool:
     the ``GELLY_SHARDED_STATE`` env var, defaulting ON — descriptors that
     supply a spec ride the owner-sharded path unless explicitly disabled.
     """
-    n = getattr(cfg, "sharded_state", -1)
-    if n in (0, 1):
-        return bool(n)
-    env = os.environ.get("GELLY_SHARDED_STATE")
-    if env is not None:
-        val = env.strip().lower()
-        if val in ("0", "false", "off", "no"):
-            return False
-        if val in ("1", "true", "on", "yes"):
-            return True
-        # an unrecognized spelling must not silently enable the plane the
-        # operator meant to switch: refuse loudly
-        raise ValueError(
-            f"GELLY_SHARDED_STATE={env!r} is not a recognized switch "
-            "(use 0/false/off/no or 1/true/on/yes)"
-        )
-    return True
+    return resolve_switch(
+        getattr(cfg, "sharded_state", -1), "GELLY_SHARDED_STATE", default=True
+    )
 
 
 class ShardContext(NamedTuple):

@@ -397,28 +397,69 @@ class EdgeStream:
         from ..io import wire as _wire
 
         if width not in (2, 3, 4, _wire.PAIR40) and not (
-            isinstance(width, tuple) and len(width) == 2 and width[0] == _wire.EF40
+            isinstance(width, tuple)
+            and len(width) == 2
+            and width[0] in (_wire.EF40, _wire.BDV)
         ):
             raise ValueError(f"unsupported wire width {width}")
         cap = cfg.vertex_capacity
+        is_bdv = isinstance(width, tuple) and width[0] == _wire.BDV
         if isinstance(width, tuple) and width[1] > cap:
             raise ValueError(
-                f"EF40 width capacity {width[1]} exceeds "
-                f"cfg.vertex_capacity {cap}: decoded ids could reach "
-                f"{width[1] - 1} and silently corrupt device state; "
+                f"{width[0].upper()} width capacity {width[1]} exceeds "
+                f"cfg.vertex_capacity {cap}: decoded ids could reach or "
+                "pass it and silently corrupt device state; "
                 "intern ids first (io.interning.VertexInterner)"
             )
         expect = _wire.wire_nbytes(batch_size, width)
+        # the 1-byte-per-value floor: control block + one byte per varint.
+        # Shorter buffers cannot hold batch_size edges, and the device
+        # decoder's clipped gathers would silently read garbage instead of
+        # raising (devices cannot) — so the lower bound is checked PER
+        # buffer, like the exact size is for fixed widths
+        bdv_min = (2 * batch_size + 3) // 4 + 2 * batch_size
         for i, b in enumerate(bufs):
             b = np.asarray(b)
             if b.dtype != np.uint8:
                 # a same-nbytes buffer of another dtype would sign-extend /
                 # mis-slice in the device decode — wire bytes are uint8
                 raise ValueError(f"wire buffer {i} has dtype {b.dtype}, not uint8")
-            if b.nbytes != expect:
+            if is_bdv:
+                # BDV buffers are data-dependent sizes under the worst-case
+                # bound (delta/varint payload + bucket padding)
+                if b.nbytes > expect:
+                    raise ValueError(
+                        f"BDV wire buffer {i} holds {b.nbytes} bytes; "
+                        f"batch_size={batch_size} caps at {expect}"
+                    )
+                if b.nbytes < bdv_min:
+                    raise ValueError(
+                        f"BDV wire buffer {i} holds {b.nbytes} bytes, "
+                        f"truncated below the {bdv_min}-byte minimum for "
+                        f"batch_size={batch_size}"
+                    )
+            elif b.nbytes != expect:
                 raise ValueError(
                     f"wire buffer {i} holds {b.nbytes} bytes; "
                     f"batch_size={batch_size} at width {width} needs {expect}"
+                )
+        if is_bdv and bufs:
+            # varints can express ids past the claimed capacity: decode the
+            # FIRST buffer as a smoke guard (full validation of every
+            # buffer is the producer's contract, as for fixed widths)
+            s0, d0 = _wire.unpack_edges_host(np.asarray(bufs[0]), batch_size, width)
+            # BDV is the one wire format that can decode NEGATIVE ids
+            # (signed zigzag src deltas), and a negative scatter index
+            # silently wraps to the end of the summary arrays — guard both
+            # ends of the range, like the tail-ids check below
+            if len(s0) and (
+                int(min(s0.min(), d0.min())) < 0
+                or int(max(s0.max(), d0.max())) >= cap
+            ):
+                raise ValueError(
+                    f"wire buffer 0 decodes vertex ids outside "
+                    f"[0, vertex_capacity {cap}); intern ids first "
+                    "(io.interning.VertexInterner)"
                 )
         if not isinstance(width, tuple):
             # fixed-width encodings can express ids beyond vertex_capacity;

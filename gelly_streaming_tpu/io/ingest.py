@@ -335,6 +335,147 @@ def fill_pane_rows_into(
     )
 
 
+def pack_bdv_group(
+    src: np.ndarray,
+    dst: np.ndarray,
+    first_batch: int,
+    group: int,
+    batch: int,
+    capacity: int,
+    workers: int = 0,
+) -> np.ndarray:
+    """Bin + compress ``group`` consecutive batches into one stacked arena.
+
+    Each row is a BDV buffer (io/wire.pack_edges_bdv: (dst, src) sort +
+    delta/varint encode) packed by a pool worker; rows then pad to the
+    GROUP's max byte bucket — BDV buffers are data-dependent sizes, so the
+    group arena buckets to its own max instead of a fixed slice width (the
+    trailing zeros decode as dropped empty varint groups).  Returns
+    ``uint8[group, bucket]``; bucket sizes reuse the pow2-family bucketing
+    (wire.bdv_bucket_nbytes), keeping compiled scan shapes cache-stable
+    across same-regime groups.
+    """
+    from gelly_streaming_tpu.io import wire
+
+    def one(j: int) -> np.ndarray:
+        i = first_batch + j
+        return wire.pack_edges_bdv(
+            src[i * batch : (i + 1) * batch],
+            dst[i * batch : (i + 1) * batch],
+            capacity,
+            record_stats=True,
+        )
+
+    workers = resolve_workers(workers)
+    if workers <= 1 or group == 1:
+        bufs = [one(j) for j in range(group)]
+    else:
+        bufs = _run_parallel([lambda j=j: one(j) for j in range(group)], workers)
+    bucket = max(b.nbytes for b in bufs)
+    arena = np.zeros((group, bucket), np.uint8)
+    for j, b in enumerate(bufs):
+        arena[j, : b.nbytes] = b
+    return arena
+
+
+def pack_binned_rows_into(
+    src: np.ndarray,
+    dst: np.ndarray,
+    first_batch: int,
+    group: int,
+    batch: int,
+    width,
+    capacity: int,
+    arena: np.ndarray,
+    workers: int = 0,
+) -> None:
+    """``pack_rows_into`` with destination binning: each row's batch sorts
+    by (dst, src) on its pool worker before packing at the PLAIN fixed
+    width — same transfer bytes, segment-local device folds (the
+    binned-without-compression half of propagation blocking)."""
+    from gelly_streaming_tpu.io import wire
+
+    def one(j: int) -> None:
+        i = first_batch + j
+        s_b, d_b = wire.sort_edges_binned(
+            src[i * batch : (i + 1) * batch],
+            dst[i * batch : (i + 1) * batch],
+            capacity,
+            record_stats=True,
+        )
+        wire.pack_edges_into(s_b, d_b, width, arena[j])
+
+    workers = resolve_workers(workers)
+    if workers <= 1 or group == 1:
+        for j in range(group):
+            one(j)
+        return
+    _run_parallel([lambda j=j: one(j) for j in range(group)], workers)
+
+
+def parallel_host_route(
+    src: np.ndarray,
+    dst: np.ndarray,
+    num_shards: int,
+    key: str = "src",
+    capacity: Optional[int] = None,
+    workers: int = 0,
+):
+    """``routing.host_route`` sharded across the ingest worker pool.
+
+    The keyBy bucketing moved into the parse/pack pass (ISSUE 6): each
+    worker routes a contiguous chunk through the native single-pass router,
+    then per-shard chunks concatenate in chunk order — arrival order within
+    a shard is preserved, so the result is BIT-IDENTICAL to the serial
+    ``host_route`` (pinned by tests/test_binned_ingest.py).  Bucket
+    capacities reuse the pow2 bucketing (never exact occupancy — the
+    retrace-guard satellite), so skewed panes resolve to the same compiled
+    step shapes as balanced ones.
+    """
+    from gelly_streaming_tpu.parallel import routing
+
+    workers = resolve_workers(workers)
+    n = len(src)
+    chunk = -(-n // workers) if workers > 1 else n
+    if workers <= 1 or n < (1 << 14) or chunk == 0:
+        return routing.host_route(src, dst, num_shards, key=key, capacity=capacity)
+    bounds = list(range(0, n, chunk)) + [n]
+    parts = _run_parallel(
+        [
+            lambda b=b, e=e: routing.host_route(
+                src[b:e], dst[b:e], num_shards, key=key
+            )
+            for b, e in zip(bounds[:-1], bounds[1:])
+        ],
+        workers,
+    )
+    counts = [p.mask.sum(axis=1) for p in parts]
+    totals = np.sum(counts, axis=0)
+    # pow2 bin-arena capacity (explicit capacities honored as given): the
+    # compile-cache keys downstream bake this in, so exact-size allocations
+    # would retrace on every skewed pane
+    cap = capacity or routing.pow2_bucket(int(totals.max()) if n else 1)
+    s = np.zeros((num_shards, cap), np.int32)
+    d = np.zeros((num_shards, cap), np.int32)
+    m = np.zeros((num_shards, cap), bool)
+
+    def fill(shard: int) -> None:
+        o = 0
+        for p, c in zip(parts, counts):
+            k = min(int(c[shard]), cap - o)
+            if k <= 0:
+                continue
+            s[shard, o : o + k] = p.src[shard, :k]
+            d[shard, o : o + k] = p.dst[shard, :k]
+            o += k
+        m[shard, :o] = True
+
+    _run_parallel(
+        [lambda sh=sh: fill(sh) for sh in range(num_shards)], workers
+    )
+    return routing.RoutedEdges(s, d, m, None)
+
+
 def parallel_pack_stream(
     src: np.ndarray,
     dst: np.ndarray,

@@ -31,11 +31,53 @@ from typing import Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from ..utils.envswitch import resolve_switch
 from ..utils.native import load_ingest_lib
 
 
 PAIR40 = "pair40"  # 5-byte (src, dst) pair packing for capacities <= 2^20
 EF40 = "ef40"  # sorted Elias-Fano multiset packing (order-free folds only)
+BDV = "bdv"  # destination-binned delta/varint packing (order-free folds only)
+
+# BDV ids (and zigzag values) are bounded so every varint fits 4 bytes and
+# the device decoder's uint32 shifts cannot overflow (ops/wire_decode.py)
+BDV_MAX_ID_BITS = 28
+# the native sorter covers the whole BDV id range (counting sorts to 2^22,
+# packed-key radix beyond); numpy lexsort is the no-library fallback only
+_BDV_NATIVE_SORT_CAP = 1 << 28
+
+
+def resolve_binned_ingest(cfg) -> bool:
+    """Effective destination-binning switch: config > env > off.
+
+    ``cfg.binned_ingest``: 1 forces on, 0 forces off, -1 (default) defers to
+    the ``GELLY_BINNED_INGEST`` env var, defaulting OFF — the unbinned
+    arrival-order layout stays the equivalence oracle.  Compression implies
+    binning (delta encoding needs the sorted bins), so a resolved
+    ``wire_compress`` turns this on too — but an EXPLICIT
+    ``binned_ingest=0`` pins the oracle even against an ambient
+    ``GELLY_WIRE_COMPRESS=1`` (config beats env on both switches).
+    """
+    if getattr(cfg, "binned_ingest", -1) == 0:
+        return False
+    if resolve_wire_compress(cfg):
+        return True
+    return resolve_switch(getattr(cfg, "binned_ingest", -1), "GELLY_BINNED_INGEST")
+
+
+def resolve_wire_compress(cfg) -> bool:
+    """Effective wire-compression switch: config > env > off (the plain
+    fixed-width layout remains the oracle).  ``cfg.wire_compress``: 1 on,
+    0 off, -1 defers to ``GELLY_WIRE_COMPRESS``.  An explicit
+    ``binned_ingest=0`` pins the arrival-order oracle, so ambient env
+    compression cannot ride it (the config-forced combination is already
+    rejected in ``StreamConfig.__post_init__``)."""
+    if (
+        getattr(cfg, "binned_ingest", -1) == 0
+        and getattr(cfg, "wire_compress", -1) != 1
+    ):
+        return False
+    return resolve_switch(getattr(cfg, "wire_compress", -1), "GELLY_WIRE_COMPRESS")
 
 
 def width_for_capacity(capacity: int):
@@ -91,11 +133,18 @@ def _unpack_edges40(wire, n: int, xp=None):
 
 
 def wire_nbytes(n: int, width) -> int:
-    """Wire bytes for an n-edge batch at a fixed-width encoding."""
+    """Wire bytes for an n-edge batch at a fixed-width encoding.
+
+    BDV buffers are data-dependent (that is the point); this returns their
+    WORST-CASE bound, the validation/arena ceiling — actual buffers are
+    pow2-padded payloads at or under it.
+    """
     if width == PAIR40:
         return 5 * n
-    if isinstance(width, tuple):  # (EF40, capacity)
-        return ef40_nbytes(n, width[1])
+    if isinstance(width, tuple):
+        if width[0] == BDV:
+            return bdv_max_nbytes(n)
+        return ef40_nbytes(n, width[1])  # (EF40, capacity)
     return 2 * n * width
 
 
@@ -174,6 +223,287 @@ def unpack_edges_ef40(wire, n: int, capacity: int):
     return src, dst
 
 
+# ---------------------------------------------------------------------------
+# BDV: destination-binned delta/varint wire format (ISSUE 6).
+#
+# Propagation blocking (arXiv:2011.08451) applied to the host->device link: a
+# micro-batch is binned/sorted by (dst, src) — legal only for ORDER-FREE folds,
+# which see the same multiset — then shipped as one interleaved varint stream:
+# per edge a dst delta (sorted, so mostly 0/tiny = 1 byte), then the src
+# (absolute at each dst-run start, an ascending delta within the run).  A
+# valued batch appends a zigzag-varint int32 value per edge.  On graphs with
+# any destination locality this lands well under the fixed-width floor (the
+# bench's skewed sample measures ~2-2.5 B/edge vs 5 for PAIR40 and 8 raw),
+# and the sorted batch makes the consumer's fold scatter SEGMENT-LOCAL — the
+# cache-win half of the papers (arXiv:1608.01362).  Buffers pow2-pad for
+# shape-stable transfers; the device decoder (ops/wire_decode.py) drops the
+# padding as empty varint groups.
+
+
+def bdv_max_nbytes(n: int, valued: bool = False) -> int:
+    """Worst-case BDV bytes for an n-edge batch: a 4-byte dst-delta varint
+    plus a 5-byte zigzag src-delta varint per edge (plus a 5-byte zigzag
+    value when valued)."""
+    return (14 if valued else 9) * max(int(n), 1)
+
+
+def _sort_edges_bdv(src: np.ndarray, dst: np.ndarray, capacity: int, val=None):
+    """(dst, src)-stable-sorted copy of a batch: native cache-blocked
+    counting sort when available (value-less, capacity in table range),
+    else numpy lexsort — identical output order either way."""
+    n = src.shape[0]
+    if val is None and n and capacity <= _BDV_NATIVE_SORT_CAP:
+        lib = load_ingest_lib()
+        if lib is not None and hasattr(lib, "sort_edges_dst_src"):
+            out_s = np.empty(n, np.int32)
+            out_d = np.empty(n, np.int32)
+            rows = lib.sort_edges_dst_src(
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n,
+                capacity,
+                out_s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out_d.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            if rows == n:
+                return out_s, out_d, None
+    order = np.lexsort((src, dst))
+    return (
+        src[order],
+        dst[order],
+        None if val is None else jax_tree_take(val, order),
+    )
+
+
+def jax_tree_take(val, order):
+    """Permute every leaf of a per-edge value pytree by ``order`` (host)."""
+    import jax
+
+    return jax.tree.map(lambda a: np.asarray(a)[order], val)
+
+
+def _varint_encode_np(vals: np.ndarray) -> np.ndarray:
+    """uint32-ish value array -> group-varint bytes (control block of 2-bit
+    lengths, then little-endian value bytes) — byte-identical to the native
+    encoder's stream (vectorized)."""
+    vals = np.asarray(vals, np.uint64)
+    count = len(vals)
+    ctrl = (count + 3) // 4
+    lens = np.ones(count, np.int64)
+    for k in (8, 16, 24):
+        lens += vals >= (np.uint64(1) << np.uint64(k))
+    ends = np.cumsum(lens)
+    total = ctrl + (int(ends[-1]) if count else 0)
+    out = np.zeros(total, np.uint8)
+    k = np.arange(count)
+    np.bitwise_or.at(
+        out, k >> 2, ((lens - 1) << (2 * (k & 3))).astype(np.uint8)
+    )
+    starts = ctrl + ends - lens
+    for j in range(4):
+        sel = lens > j
+        if not sel.any():
+            break
+        out[starts[sel] + j] = (
+            (vals[sel] >> np.uint64(8 * j)) & np.uint64(0xFF)
+        ).astype(np.uint8)
+    return out
+
+
+def _varint_decode_np(buf: np.ndarray, count: int) -> np.ndarray:
+    """Host twin of ops.wire_decode.decode_varints (numpy, same layout).
+
+    Unlike the device decoder (whose clipped gathers silently read garbage
+    from a short buffer — devices cannot raise), this host path REFUSES a
+    buffer shorter than its own control block + payload: it is the
+    validation front door (``EdgeStream.from_wire``'s smoke guard and the
+    replay slow path), so truncation must be a clean error."""
+    b = np.asarray(buf, np.uint8).astype(np.int64)
+    ctrl = (count + 3) // 4
+    nb_in = len(b)
+    if nb_in < ctrl:
+        raise ValueError(
+            f"BDV buffer truncated: {count} varints need a {ctrl}-byte "
+            f"control block, got {nb_in} bytes total"
+        )
+    k = np.arange(count)
+    lens = ((b[k >> 2] >> (2 * (k & 3))) & 3) + 1 if count else np.zeros(0, np.int64)
+    needed = ctrl + (int(lens.sum()) if count else 0)
+    if nb_in < needed:
+        raise ValueError(
+            f"BDV buffer truncated: control block declares {needed} bytes, "
+            f"got {nb_in}"
+        )
+    starts = ctrl + np.cumsum(lens) - lens
+    vals = np.zeros(count, np.int64)
+    nb = len(b)
+    for j in range(4):
+        idx = np.minimum(starts + j, nb - 1)
+        vals |= np.where(lens > j, b[idx] << (8 * j), 0)
+    return vals
+
+
+def _zigzag_encode_np(v: np.ndarray) -> np.ndarray:
+    v = np.asarray(v, np.int64)
+    return np.asarray((v << 1) ^ (v >> 63), np.uint64)
+
+
+def _encode_bdv_np(src_s, dst_s, val_i32=None) -> np.ndarray:
+    """Varint-encode a dst-sorted batch (numpy fallback encoder —
+    byte-identical to the native encode_edges_bdv): unsigned dst deltas
+    interleaved with GLOBAL zigzag src deltas (src[-1] = 0), so the decode
+    is a pair of cumsums."""
+    n = len(src_s)
+    per = 2 if val_i32 is None else 3
+    s = np.asarray(src_s, np.int64)
+    d = np.asarray(dst_s, np.int64)
+    d_delta = np.empty(n, np.int64)
+    s_delta = np.empty(n, np.int64)
+    if n:
+        d_delta[0] = d[0]
+        d_delta[1:] = np.diff(d)
+        s_delta[0] = s[0]
+        s_delta[1:] = np.diff(s)
+    stream = np.empty(per * n, np.uint64)
+    stream[0::per] = d_delta.astype(np.uint64)
+    stream[1::per] = _zigzag_encode_np(s_delta) & np.uint64(0xFFFFFFFF)
+    if val_i32 is not None:
+        stream[2::per] = _zigzag_encode_np(np.asarray(val_i32, np.int64))
+    return _varint_encode_np(stream)
+
+
+def sort_edges_binned(
+    src: np.ndarray,
+    dst: np.ndarray,
+    capacity: int,
+    record_stats: bool = False,
+):
+    """Destination-bin a value-less batch: the (dst, src) stable sort every
+    binned-ingest site shares (native sorter when available, numpy lexsort
+    fallback — identical order either way).  ``record_stats`` bumps the
+    wire-path bin-occupancy high-water (utils.metrics) — hot-path callers
+    only.  Returns ``(src_sorted, dst_sorted)``."""
+    s, d, _ = _sort_edges_bdv(
+        np.ascontiguousarray(src, dtype=np.int32),
+        np.ascontiguousarray(dst, dtype=np.int32),
+        capacity,
+    )
+    if record_stats:
+        from ..utils import metrics as _metrics
+
+        _metrics.wire_high_water("wire_bin_occupancy_hwm", max_dst_run(d))
+    return s, d
+
+
+def max_dst_run(dst_sorted: np.ndarray) -> int:
+    """Longest equal-dst run of a sorted dst column — the bin-occupancy
+    figure the wire metrics high-water (utils.metrics wire counters)."""
+    n = len(dst_sorted)
+    if n == 0:
+        return 0
+    bounds = np.flatnonzero(np.diff(dst_sorted) != 0)
+    edges = np.concatenate([[-1], bounds, [n - 1]])
+    return int(np.max(np.diff(edges)))
+
+
+def pack_edges_bdv(
+    src: np.ndarray,
+    dst: np.ndarray,
+    capacity: int,
+    val_i32: Optional[np.ndarray] = None,
+    sort: bool = True,
+    record_stats: bool = False,
+) -> np.ndarray:
+    """Bin + compress an edge batch into a bucket-padded BDV wire buffer.
+
+    Sorts by (dst, src) unless the caller already did (``sort=False``),
+    varint-encodes (native encoder on the value-less path, numpy fallback
+    byte-identical), and zero-pads to the byte bucket
+    (``bdv_bucket_nbytes``) so same-shape batches reuse one compiled
+    decode+fold executable.  Ships a MULTISET: order-free consumers only
+    (the same contract as EF40).  ``record_stats`` bumps the wire-path
+    bin-occupancy high-water (utils.metrics) — hot-path callers only.
+    """
+    if capacity <= 0 or capacity > (1 << BDV_MAX_ID_BITS):
+        raise ValueError(
+            f"BDV needs 0 < capacity <= 2^{BDV_MAX_ID_BITS} (got {capacity})"
+        )
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    n = src.shape[0]
+    if dst.shape[0] != n:
+        raise ValueError("src/dst length mismatch")
+    if sort:
+        src, dst, val_i32 = _sort_edges_bdv(src, dst, capacity, val_i32)
+    if record_stats:
+        from ..utils import metrics as _metrics
+
+        _metrics.wire_high_water("wire_bin_occupancy_hwm", max_dst_run(dst))
+    payload = None
+    if val_i32 is None:
+        lib = load_ingest_lib()
+        if lib is not None and hasattr(lib, "encode_edges_bdv"):
+            out = np.empty(bdv_max_nbytes(n) + 8, np.uint8)
+            wrote = lib.encode_edges_bdv(
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                out.nbytes,
+            )
+            if wrote >= 0:
+                payload = out[:wrote]
+    if payload is None:
+        payload = _encode_bdv_np(src, dst, val_i32)
+    # bucket padding clamps at the documented worst-case bound: wire_nbytes
+    # is the validation/arena ceiling (EdgeStream.from_wire, the mesh replay
+    # rows), so a near-worst-case payload must never bucket PAST it
+    bucket = min(
+        bdv_bucket_nbytes(len(payload)),
+        bdv_max_nbytes(n, val_i32 is not None),
+    )
+    buf = np.zeros(bucket, np.uint8)
+    buf[: len(payload)] = payload
+    return buf
+
+
+def bdv_bucket_nbytes(payload_nbytes: int) -> int:
+    """Shape bucket for a BDV payload: the next size of form {4,5,6,7}<<k.
+
+    Pure pow2 bucketing wastes up to half the transfer on padding — real
+    bytes on the link the format exists to relieve; quarter-octave buckets
+    cap the pad at 25% while keeping the compiled-shape set small and
+    stable (4 sizes per octave, so same-regime batches still reuse one
+    decode+fold executable — the retrace guard pins it).
+    """
+    n = max(int(payload_nbytes), 4)
+    k = max((n - 1).bit_length() - 3, 0)
+    return -(-n >> k) << k  # ceil to a multiple of 2^k
+
+
+def unpack_edges_bdv_host(buf: np.ndarray, n: int, valued: bool = False):
+    """Host (numpy) BDV decode -> (src, dst[, val]) int32[n] in the packed
+    (dst, src)-sorted multiset order — the replay slow path and the
+    device-decode oracle (host==device pinned by tests/test_wire_bdv.py)."""
+    per = 3 if valued else 2
+    vals = _varint_decode_np(np.asarray(buf, np.uint8), per * n)
+    d_delta = vals[0::per]
+    s_enc = vals[1::per].astype(np.uint64)
+    dst = np.cumsum(d_delta).astype(np.int32)
+    # global zigzag src deltas: the chain telescopes, so src is one cumsum
+    s_delta = ((s_enc >> np.uint64(1)).astype(np.int64)) ^ -(
+        s_enc & np.uint64(1)
+    ).astype(np.int64)
+    src = np.cumsum(s_delta).astype(np.int32)
+    if not valued:
+        return src, dst
+    z = vals[2::per].astype(np.uint64)
+    val = ((z >> np.uint64(1)).astype(np.int64)) ^ -(z & np.uint64(1)).astype(
+        np.int64
+    )
+    return src, dst, val.astype(np.int32)
+
+
 def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
     """Pack an edge batch into a uint8 wire buffer.
 
@@ -181,7 +511,7 @@ def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
     to little-endian bytes) or ``PAIR40`` (5-byte packed pairs).
     """
     if width not in (2, 3, 4, PAIR40) and not (
-        isinstance(width, tuple) and width[0] == EF40
+        isinstance(width, tuple) and width[0] in (EF40, BDV)
     ):
         raise ValueError(f"unsupported wire width {width}")
     src = np.ascontiguousarray(src, dtype=np.int32)
@@ -189,7 +519,9 @@ def pack_edges(src: np.ndarray, dst: np.ndarray, width) -> np.ndarray:
     n = src.shape[0]
     if dst.shape[0] != n:
         raise ValueError("src/dst length mismatch")
-    if isinstance(width, tuple):  # (EF40, capacity)
+    if isinstance(width, tuple):  # (EF40 | BDV, capacity)
+        if width[0] == BDV:
+            return pack_edges_bdv(src, dst, width[1])
         return _pack_edges_ef40(src, dst, width[1])
     if width == PAIR40:
         return _pack_edges40(src, dst)
@@ -222,6 +554,11 @@ def pack_edges_into(src: np.ndarray, dst: np.ndarray, width, out: np.ndarray) ->
     (io/ingest.py) rides; without the native library the packed bytes are
     copied in from the allocating packer (one extra memcpy, same bytes).
     """
+    if isinstance(width, tuple) and width[0] == BDV:
+        # BDV rows are data-dependent sizes; fixed-slice arena packing has
+        # no meaningful contract for them — group arenas bucket to the
+        # group's own max instead (io/ingest.pack_bdv_group)
+        raise ValueError("BDV buffers are variable-size; use pack_edges_bdv")
     src = np.ascontiguousarray(src, dtype=np.int32)
     dst = np.ascontiguousarray(dst, dtype=np.int32)
     n = src.shape[0]
@@ -258,7 +595,11 @@ def unpack_edges(wire, n: int, width, xp=None):
     fixed-width encodings — the same code path, so host and device cannot
     disagree.  EF40 needs the device scatter (or ``unpack_edges_host``).
     """
-    if isinstance(width, tuple):  # (EF40, capacity)
+    if isinstance(width, tuple):  # (EF40 | BDV, capacity)
+        if width[0] == BDV:
+            from gelly_streaming_tpu.ops import wire_decode
+
+            return wire_decode.decode_bdv(wire, n)
         return unpack_edges_ef40(wire, n, width[1])
     if xp is None:
         import jax.numpy as xp
@@ -358,6 +699,8 @@ def unpack_edges_host(buf: np.ndarray, n: int, width):
     sequence — same contract as the device unpack).
     """
     buf = np.asarray(buf, np.uint8)
+    if isinstance(width, tuple) and width[0] == BDV:
+        return unpack_edges_bdv_host(buf, n)
     if isinstance(width, tuple):  # (EF40, capacity)
         capacity = width[1]
         bvbytes = (n + capacity + 7) // 8

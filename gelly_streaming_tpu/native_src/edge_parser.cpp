@@ -485,6 +485,175 @@ int64_t pack_edges_ef40(const int32_t* src, const int32_t* dst, int64_t n,
   return q - out;
 }
 
+// ---------------------------------------------------------------------------
+// Propagation-blocking ingest (arXiv:2011.08451, arXiv:1608.01362): bin a
+// micro-batch by destination so the device fold's scatter walks the summary
+// arrays in order (cache-resident segments instead of random [C] misses), and
+// the wire encoder below can ship small sorted deltas instead of full ids.
+//
+// sort_edges_dst_src: stable counting sort of an edge batch by (dst, src) —
+// the bin pass.  Two passes of a cache-blocked counting sort (by src first,
+// then stably by dst) so the count tables stay L1/L2-resident at any capacity
+// the Python side routes here (it falls back to numpy lexsort beyond 2^22).
+// Output order is exactly numpy's lexsort((src, dst)) — byte-identical wire
+// buffers whichever path packs (pinned by tests/test_wire_bdv.py).
+
+namespace {
+
+// One stable counting-sort pass of (key, carry) pairs; keys < capacity.
+// in_k/in_c -> out_k/out_c.  Returns false on alloc failure.
+bool counting_pass(const int32_t* in_k, const int32_t* in_c, int64_t n,
+                   int32_t capacity, int32_t* out_k, int32_t* out_c) {
+  uint32_t* off = static_cast<uint32_t*>(calloc((size_t)capacity + 1, 4));
+  if (!off) return false;
+  for (int64_t i = 0; i < n; ++i) off[(uint32_t)in_k[i]]++;
+  uint32_t sum = 0;
+  for (int32_t v = 0; v <= capacity; ++v) {
+    uint32_t c = (v < capacity) ? off[v] : 0;
+    off[v] = sum;
+    sum += c;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t slot = off[(uint32_t)in_k[i]]++;
+    out_k[slot] = in_k[i];
+    out_c[slot] = in_c[i];
+  }
+  free(off);
+  return true;
+}
+
+// LSB radix sort of packed (dst << 28 | src) keys: 4 stable passes of
+// 14-bit digits, 64 KB count tables (cache-resident at ANY capacity — the
+// per-vertex counting tables above stop fitting past ~2^22 ids).  Requires
+// ids < 2^28 (the BDV varint bound).  Returns false on alloc failure.
+bool radix_sort_dst_src(const int32_t* src, const int32_t* dst, int64_t n,
+                        int32_t* out_src, int32_t* out_dst) {
+  constexpr int kDigit = 14;
+  constexpr uint32_t kMask = (1u << kDigit) - 1;
+  uint64_t* a = static_cast<uint64_t*>(malloc((size_t)n * 8));
+  uint64_t* b = static_cast<uint64_t*>(malloc((size_t)n * 8));
+  uint32_t* count = static_cast<uint32_t*>(malloc((1u << kDigit) * 4));
+  if (!a || !b || !count) {
+    free(a);
+    free(b);
+    free(count);
+    return false;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    a[i] = ((uint64_t)(uint32_t)dst[i] << 28) | (uint32_t)src[i];
+  }
+  uint64_t* from = a;
+  uint64_t* to = b;
+  for (int shift = 0; shift < 56; shift += kDigit) {
+    memset(count, 0, (1u << kDigit) * 4);
+    for (int64_t i = 0; i < n; ++i) count[(from[i] >> shift) & kMask]++;
+    uint32_t sum = 0;
+    for (uint32_t d = 0; d < (1u << kDigit); ++d) {
+      uint32_t c = count[d];
+      count[d] = sum;
+      sum += c;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      to[count[(from[i] >> shift) & kMask]++] = from[i];
+    }
+    uint64_t* t = from;
+    from = to;
+    to = t;
+  }
+  for (int64_t i = 0; i < n; ++i) {  // 4 passes: result is back in `a`
+    out_src[i] = (int32_t)(from[i] & ((1u << 28) - 1));
+    out_dst[i] = (int32_t)(from[i] >> 28);
+  }
+  free(a);
+  free(b);
+  free(count);
+  return true;
+}
+
+}  // namespace
+
+// Sort an edge batch by (dst, src), stable — src ascending within equal dst.
+// Writes the sorted batch into out_src/out_dst (must not alias the inputs).
+// Per-vertex counting sorts up to 2^22 ids (tables within cache), the
+// packed-key radix sort beyond (ids must fit the 28-bit BDV bound there).
+// Returns n, or -1 on error (ids out of [0, capacity), alloc failure).
+int64_t sort_edges_dst_src(const int32_t* src, const int32_t* dst, int64_t n,
+                           int32_t capacity, int32_t* out_src,
+                           int32_t* out_dst) {
+  if (capacity <= 0 || n < 0 || capacity > (1 << 28)) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    if ((uint32_t)src[i] >= (uint32_t)capacity ||
+        (uint32_t)dst[i] >= (uint32_t)capacity)
+      return -1;
+  }
+  if (capacity > (1 << 22)) {
+    return radix_sort_dst_src(src, dst, n, out_src, out_dst) ? n : -1;
+  }
+  int32_t* tk = static_cast<int32_t*>(malloc((size_t)n * 4));
+  int32_t* tc = static_cast<int32_t*>(malloc((size_t)n * 4));
+  if (!tk || !tc) {
+    free(tk);
+    free(tc);
+    return -1;
+  }
+  // pass 1: by src (key = src, carry = dst); pass 2: stably by dst
+  bool ok = counting_pass(src, dst, n, capacity, tk, tc) &&
+            counting_pass(tc, tk, n, capacity, out_dst, out_src);
+  free(tk);
+  free(tc);
+  return ok ? n : -1;
+}
+
+// Delta/group-varint wire encode of a dst-SORTED edge batch.  Per edge the
+// value stream carries the dst delta from the previous edge (unsigned —
+// sorted, so mostly 0/tiny) then the src as a GLOBAL zigzag delta
+// src[i] - src[i-1] (src[-1] = 0; the chain telescopes, so the decoder is
+// one cumsum, and on community-clustered graphs consecutive sorted edges
+// share a neighborhood so the deltas stay small across dst-run boundaries).
+//
+// The stream is GROUP varint, not LEB128: a control block of 2-bit byte
+// lengths (1..4, four values per control byte, value k at control[k>>2]
+// bits 2*(k&3)) sits at the buffer head, followed by the little-endian
+// value bytes.  The device decoder (ops/wire_decode.py) then needs only a
+// cumsum of lengths and four clipped gathers — no per-byte scan, and no
+// scatter, which XLA's CPU backend lowers to a serial loop.  Denser than
+// LEB128 too: 8-bit payloads + 0.25 amortized control vs 7+1 per byte.
+// Callers bucket-pad for shape-stable transfers (zero padding decodes as
+// never-asked-for zero-length groups).  Returns total bytes written
+// (control + data), or -1 (dst not sorted, buffer too small).
+int64_t encode_edges_bdv(const int32_t* src, const int32_t* dst, int64_t n,
+                         uint8_t* out, int64_t out_cap) {
+  int64_t count = 2 * n;
+  int64_t ctrl = (count + 3) / 4;
+  if (out_cap < ctrl + 8 * n) return -1;
+  memset(out, 0, ctrl);
+  uint8_t* q = out + ctrl;
+  int32_t prev_d = 0;
+  int32_t prev_s = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t dd = dst[i] - prev_d;
+    if (dd < 0) return -1;
+    int32_t ds = src[i] - prev_s;
+    uint32_t vals2[2] = {
+        (uint32_t)dd,
+        ((uint32_t)ds << 1) ^ (uint32_t)(ds >> 31),
+    };
+    for (int v = 0; v < 2; ++v) {
+      uint32_t x = vals2[v];
+      int len = 1 + (x >= 0x100u) + (x >= 0x10000u) + (x >= 0x1000000u);
+      int64_t k = 2 * i + v;
+      out[k >> 2] |= (uint8_t)((len - 1) << ((k & 3) * 2));
+      for (int j = 0; j < len; ++j) {
+        *q++ = (uint8_t)(x & 0xFF);
+        x >>= 8;
+      }
+    }
+    prev_d = dst[i];
+    prev_s = src[i];
+  }
+  return q - out;
+}
+
 // Host keyBy router: scatter edges into per-owner-shard buckets in ONE pass
 // (owner = key % num_shards; key is src or dst).  The numpy path selects each
 // shard's edges with a boolean mask — S full passes over the batch; this is

@@ -66,7 +66,7 @@ def _kernel(a_row_ref, a_col_ref, a_tile_ref, out_ref):
 MAX_K = 1 << 14
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret",))  # graft: disable=RAWJIT — module-scope decorator: one process-global jit per import, no per-call closure to key a cache entry on
 def _count_halves(adj: jax.Array, *, interpret: bool = False) -> jax.Array:
     k = adj.shape[0]
     a = adj.astype(jnp.bfloat16)
@@ -130,7 +130,7 @@ def _adjacency_count(u, v, ok, k: int, interpret: bool):
 _ID_BITS = 14  # MAX_K = 2^14, so a (u, v) pair packs into 28 bits of a uint32
 
 
-@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))  # graft: disable=RAWJIT — module-scope decorator: one process-global jit per import, no per-call closure to key a cache entry on
 def _count_from_packed(w, n, k: int, interpret: bool):
     """Device-side pane count from the 4 B/edge packed pane wire format.
 

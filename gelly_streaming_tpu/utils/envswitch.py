@@ -1,0 +1,38 @@
+"""Shared tri-state config/env switch resolution.
+
+Several data-plane toggles follow the same contract: an int config field in
+{-1, 0, 1} where 0/1 force the switch and -1 defers to an env var, and an
+unrecognized env spelling must REFUSE LOUDLY rather than silently flip the
+plane the operator meant to switch (`core/sharded_state.resolve_sharded_state`,
+`io/wire.resolve_binned_ingest` / `resolve_wire_compress`).  One parser here
+so the spellings — and the refusal rule — cannot drift apart per switch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_switch(name: str, default: bool) -> bool:
+    """Parse boolean env var ``name``: 0/false/off/no, 1/true/on/yes, unset
+    -> ``default``; anything else raises."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    val = env.strip().lower()
+    if val in ("0", "false", "off", "no"):
+        return False
+    if val in ("1", "true", "on", "yes"):
+        return True
+    raise ValueError(
+        f"{name}={env!r} is not a recognized switch "
+        "(use 0/false/off/no or 1/true/on/yes)"
+    )
+
+
+def resolve_switch(n: int, env_name: str, default: bool = False) -> bool:
+    """Config > env > default: ``n`` in (0, 1) forces; -1 defers to
+    ``env_switch(env_name, default)``."""
+    if n in (0, 1):
+        return bool(n)
+    return env_switch(env_name, default)

@@ -238,6 +238,80 @@ def reset_comms_stats() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Wire-path transfer accounting (the binned + compressed ingest, ISSUE 6).
+# Process-global like the pipeline counters: packing runs on the prefetcher's
+# pack thread and the ingest pool workers while stats drain elsewhere.  Byte
+# figures count the buffers actually shipped (bucket padding included — those
+# pad bytes cross the link too), next to the raw 8 B/edge the host arrays
+# would cost, so the compression ratio measures the real transfer saving.
+
+
+_WIRE_LOCK = threading.Lock()
+
+
+def _wire_zero() -> dict:
+    return {
+        # wire buffers / arenas shipped to the device (padding included)
+        "wire_bytes_total": 0,
+        # what the same edges would cost as raw int32 pairs (8 B/edge)
+        "wire_raw_bytes_total": 0,
+        # edges those buffers carried
+        "wire_edges_total": 0,
+        # micro-batches shipped (superbatch groups count their members)
+        "wire_batches": 0,
+        # longest single destination bin (equal-dst run) seen by the binning
+        # pass — the propagation-blocking skew indicator
+        "wire_bin_occupancy_hwm": 0,
+    }
+
+
+# Bumped from the pack thread and the ingest pool workers at once; the
+# annotation is enforced by the lock-discipline analyzer pass.
+_WIRE = _wire_zero()  # guarded-by: _WIRE_LOCK
+
+
+def wire_high_water(key: str, value: float) -> None:
+    """Raise a wire-path high-water mark to ``value`` if it is higher."""
+    with _WIRE_LOCK:
+        if value > _WIRE[key]:
+            _WIRE[key] = value
+
+
+def wire_record_batch(batches: int, edges: int, nbytes: int) -> None:
+    """Account one shipped wire buffer/arena under ONE lock acquisition."""
+    with _WIRE_LOCK:
+        _WIRE["wire_batches"] += int(batches)
+        _WIRE["wire_edges_total"] += int(edges)
+        _WIRE["wire_raw_bytes_total"] += 8 * int(edges)
+        _WIRE["wire_bytes_total"] += int(nbytes)
+
+
+def wire_stats() -> dict:
+    """Process-wide wire-path counters plus the derived per-edge figures:
+    ``wire_bytes_per_edge`` (shipped bytes / edges) and
+    ``wire_compress_ratio`` (raw int32-pair bytes / shipped bytes — > 1
+    means the binned/compressed formats beat raw columns).  Reported by
+    bench.py next to ``comms_stats``; ``_PARTIAL``-safe (pure host state,
+    readable even when the device never came up)."""
+    with _WIRE_LOCK:
+        out = dict(_WIRE)
+    edges = max(out["wire_edges_total"], 1)
+    out["wire_bytes_per_edge"] = round(out["wire_bytes_total"] / edges, 3)
+    out["wire_compress_ratio"] = round(
+        out["wire_raw_bytes_total"] / max(out["wire_bytes_total"], 1), 3
+    )
+    return out
+
+
+def reset_wire_stats() -> None:
+    """Zero the wire-path counters (call before a measurement window,
+    read ``wire_stats`` after)."""
+    global _WIRE
+    with _WIRE_LOCK:
+        _WIRE = _wire_zero()
+
+
+# ---------------------------------------------------------------------------
 # Per-job counter scoping (the multi-tenant job runtime, runtime/manager.py).
 # The scheduler thread, per-job sink threads, and status() readers all touch
 # these registries at once, so every access goes through _JOB_LOCK — the

@@ -186,6 +186,25 @@ def load_ingest_lib():
                 ctypes.c_int32,
             ]
             lib.flink_proxy_degrees.restype = ctypes.c_int64
+        if hasattr(lib, "sort_edges_dst_src"):
+            lib.sort_edges_dst_src.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.sort_edges_dst_src.restype = ctypes.c_int64
+        if hasattr(lib, "encode_edges_bdv"):
+            lib.encode_edges_bdv.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+            ]
+            lib.encode_edges_bdv.restype = ctypes.c_int64
         if hasattr(lib, "pack_edges_ef40"):
             lib.pack_edges_ef40.argtypes = [
                 ctypes.POINTER(ctypes.c_int32),

@@ -1,0 +1,28 @@
+"""Seeded bugs in a binned-ingest decoder dispatch (ISSUE 6 shapes): a
+blocking host sync inside the '# hot-loop' decode+fold dispatch region, and
+a wire-counter registry bumped without its lock.
+
+Expected findings: exactly one HOTSYNC and one UNGUARDED.
+Analyzer input only — never imported.
+"""
+
+import threading
+
+import numpy as np
+
+_WIRE_LOCK = threading.Lock()
+_WIRE_BYTES = 0  # guarded-by: _WIRE_LOCK
+
+
+def record_shipped(nbytes):
+    global _WIRE_BYTES
+    _WIRE_BYTES += nbytes  # BUG: pack-thread bump without the lock
+
+
+def dispatch_compressed(bufs, fold, carry):
+    # hot-loop: compressed wire dispatch (decode fuses into the fold)
+    for buf in bufs:
+        carry = fold(carry, buf)
+        np.asarray(carry)  # BUG: per-batch download restores lockstep
+    # hot-loop-end
+    return carry

@@ -1,0 +1,28 @@
+"""Clean counterpart of bad_wirebin.py: the decoder dispatch loop keeps
+every host sync out of the '# hot-loop' region, and the bin-arena wire
+counters only move under their lock.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+import numpy as np
+
+_WIRE_LOCK = threading.Lock()
+_WIRE_BYTES = 0  # guarded-by: _WIRE_LOCK
+
+
+def record_shipped(nbytes):
+    global _WIRE_BYTES
+    with _WIRE_LOCK:
+        _WIRE_BYTES += nbytes
+
+
+def dispatch_compressed(bufs, fold, carry):
+    # hot-loop: compressed wire dispatch (decode fuses into the fold)
+    for buf in bufs:
+        record_shipped(buf.nbytes)
+        carry = fold(carry, buf)
+    # hot-loop-end
+    return np.asarray(carry)  # one sync AFTER the loop drains the pipeline

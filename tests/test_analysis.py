@@ -49,7 +49,7 @@ def _package_paths():
     root = analysis.package_root()
     return [
         os.path.join(root, d)
-        for d in ("core", "io", "library", "parallel", "runtime", "utils")
+        for d in ("core", "io", "library", "ops", "parallel", "runtime", "utils")
     ]
 
 
@@ -81,6 +81,7 @@ def test_cli_package_scan_exits_zero():
             "core",
             "io",
             "library",
+            "ops",
             "parallel",
             "runtime",
         ],
@@ -167,6 +168,16 @@ def test_corpus_hotsync():
     # multi-line call — the satellite regression for hot_loop_lint's
     # original single-line marker scan
     assert _analyze("good_hotsync.py") == []
+
+
+def test_corpus_wirebin():
+    """The binned-ingest fixtures (ISSUE 6): the compressed decode+fold
+    dispatch is a '# hot-loop' region, and the wire-counter registry the
+    pack threads bump is '# guarded-by:' its lock."""
+    findings = _analyze("bad_wirebin.py")
+    assert _codes(findings) == ["HOTSYNC", "UNGUARDED"]
+    assert any("_WIRE_BYTES" in f.message for f in findings)
+    assert _analyze("good_wirebin.py") == []
 
 
 def test_corpus_collgather():

@@ -1,0 +1,314 @@
+"""Binned + compressed ingest (ISSUE 6): end-to-end equivalence + guards.
+
+The destination-binned layout and the BDV compressed wire format are
+cfg-gated (``binned_ingest`` / ``wire_compress``, env twins) with the
+arrival-order uncompressed layout as the equivalence oracle.  These tests
+pin:
+
+  * bit-identical emissions for CC and the degree summary over the wire
+    fast path, the windowed/superbatch/async pane planes, and the sharded
+    mesh planes, with binning/compression on vs the oracle;
+  * checkpoint/resume parity on the compressed fast path;
+  * ``parallel_host_route`` == ``host_route`` (the keyBy moved onto the
+    ingest pool), including pow2 bin-arena capacities (the retrace-guard
+    satellite);
+  * zero recompiles across same-shape compressed batches;
+  * wire metrics counters; config/env validation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from gelly_streaming_tpu.core.config import StreamConfig  # noqa: E402
+from gelly_streaming_tpu.core.stream import EdgeStream  # noqa: E402
+from gelly_streaming_tpu.io import ingest, wire  # noqa: E402
+from gelly_streaming_tpu.library.connected_components import (  # noqa: E402
+    ConnectedComponents,
+)
+from gelly_streaming_tpu.library.degree_distribution import (  # noqa: E402
+    DegreeDistributionSummary,
+)
+from gelly_streaming_tpu.utils import metrics  # noqa: E402
+
+CAP = 1 << 12
+N = 1 << 13
+BATCH = 1 << 10
+
+
+def _edges(seed=0, n=N, cap=CAP):
+    rng = np.random.default_rng(seed)
+    # mixed skew: hub-heavy dsts exercise long bins, the uniform half
+    # exercises sparse ones
+    half = n // 2
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = np.concatenate(
+        [
+            rng.integers(0, cap, half),
+            (cap * rng.random(n - half) ** 4).astype(np.int64) % cap,
+        ]
+    ).astype(np.int32)
+    return src, dst
+
+
+def _leaves(rec):
+    out = []
+    for x in rec:
+        if hasattr(x, "parent"):
+            out += [np.asarray(x.parent), np.asarray(x.seen)]
+        else:
+            out += [np.asarray(leaf) for leaf in jax.tree.leaves(x)]
+    return out
+
+
+def _assert_same(ref, got, label):
+    assert len(ref) == len(got), (label, len(ref), len(got))
+    for a, b in zip(ref, got):
+        la, lb = _leaves(a), _leaves(b)
+        assert len(la) == len(lb), label
+        for x, y in zip(la, lb):
+            assert np.array_equal(x, y), label
+
+
+def _run(agg_cls, src, dst, **cfg_kw):
+    cfg = StreamConfig(vertex_capacity=CAP, batch_size=BATCH, **cfg_kw)
+    return list(agg_cls().run(EdgeStream.from_arrays(src, dst, cfg)))
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents, DegreeDistributionSummary])
+@pytest.mark.timeout_cap(240)
+def test_fast_path_emissions_match_oracle(agg_cls):
+    src, dst = _edges()
+    ref = _run(agg_cls, src, dst)
+    for label, kw in [
+        ("binned", dict(binned_ingest=1)),
+        ("compressed", dict(wire_compress=1)),
+        ("compressed+superbatch", dict(wire_compress=1, superbatch=4)),
+    ]:
+        _assert_same(ref, _run(agg_cls, src, dst, **kw), label)
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents, DegreeDistributionSummary])
+@pytest.mark.timeout_cap(240)
+def test_windowed_fast_path_running_emissions_match(agg_cls):
+    """ingest_window_edges keeps the stream on the fast path with running
+    emissions: one record per window, identical with compression on."""
+    src, dst = _edges(1)
+    ref = _run(agg_cls, src, dst, ingest_window_edges=BATCH)
+    got = _run(agg_cls, src, dst, ingest_window_edges=BATCH, wire_compress=1)
+    assert len(ref) == N // BATCH
+    _assert_same(ref, got, "windowed-compressed")
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents, DegreeDistributionSummary])
+@pytest.mark.timeout_cap(300)
+def test_pane_planes_match_oracle(agg_cls):
+    """Collection-source (pane plane) streams: sync, superbatch, and async
+    windowed planes bin panes on the pack thread — same emissions."""
+    rng = np.random.default_rng(2)
+    edges = [
+        (int(s), int(d))
+        for s, d in zip(rng.integers(0, CAP, 4096), rng.integers(0, CAP, 4096))
+    ]
+
+    def run(**kw):
+        cfg = StreamConfig(
+            vertex_capacity=CAP,
+            batch_size=256,
+            ingest_window_edges=512,
+            **kw,
+        )
+        st = EdgeStream.from_collection(edges, cfg, batch_size=256)
+        return list(agg_cls().run(st))
+
+    ref = run()
+    for label, kw in [
+        ("binned", dict(binned_ingest=1)),
+        ("binned+superbatch", dict(binned_ingest=1, superbatch=4)),
+        ("binned+async", dict(binned_ingest=1, async_windows=2)),
+    ]:
+        _assert_same(ref, run(**kw), label)
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents, DegreeDistributionSummary])
+@pytest.mark.timeout_cap(300)
+def test_sharded_planes_match_oracle(agg_cls):
+    """Owner-sharded AND replicated mesh planes consume binned batches with
+    unchanged emissions (binned rows stay sorted per shard; the keyBy runs
+    on the ingest pool)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    src, dst = _edges(3)
+
+    def run(**kw):
+        cfg = StreamConfig(
+            vertex_capacity=CAP, batch_size=BATCH, num_shards=2, **kw
+        )
+        return list(agg_cls().run(EdgeStream.from_arrays(src, dst, cfg)))
+
+    ref = run()
+    _assert_same(ref, run(binned_ingest=1), "sharded-binned")
+    _assert_same(ref, run(wire_compress=1), "sharded-compress-knob")
+    repl = run(sharded_state=0)
+    _assert_same(repl, run(sharded_state=0, binned_ingest=1), "replicated-binned")
+
+
+@pytest.mark.timeout_cap(240)
+def test_compressed_checkpoint_resume(tmp_path):
+    """Positional checkpoints ride the compressed fast path unchanged:
+    a fresh run resuming from a mid-stream snapshot re-emits the same
+    final summary."""
+    src, dst = _edges(4)
+    path = str(tmp_path / "ckpt")
+
+    def run(restore):
+        cfg = StreamConfig(
+            vertex_capacity=CAP,
+            batch_size=BATCH,
+            wire_compress=1,
+            wire_checkpoint_batches=2,
+        )
+        stream = EdgeStream.from_arrays(src, dst, cfg)
+        return list(
+            ConnectedComponents().run(
+                stream, checkpoint_path=path, restore=restore
+            )
+        )
+
+    ref = run(restore=False)
+    resumed = run(restore=True)  # done-snapshot: re-emit without refolding
+    _assert_same(ref, resumed, "resume")
+
+
+@pytest.mark.timeout_cap(240)
+def test_compressed_zero_recompiles_across_same_shape_batches():
+    """Same-regime compressed batches reuse ONE decode+fold executable:
+    a second full run mints zero recompiles (and zero compiles)."""
+    from gelly_streaming_tpu.core import compile_cache
+
+    src, dst = _edges(5)
+
+    def run():
+        return _run(ConnectedComponents, src, dst, wire_compress=1)
+
+    first = run()  # compiles land here
+    compile_cache.reset_stats()
+    _assert_same(first, run(), "rerun")
+    stats = compile_cache.stats()
+    assert stats["recompiles"] == 0
+    assert stats["compiles"] == 0
+
+
+@pytest.mark.timeout_cap(240)
+def test_skewed_bin_arenas_keep_pow2_shapes():
+    """The retrace-guard satellite: routed bin arenas pow2-bucket their
+    capacity, so panes of different skew resolve to the same compiled
+    shapes — occupancies within one pow2 bucket share arena capacity."""
+    rng = np.random.default_rng(6)
+    caps = set()
+    for skew in (1, 2, 4, 6):
+        src = rng.integers(0, CAP, 1 << 14).astype(np.int32)
+        dst = ((CAP * rng.random(1 << 14) ** skew).astype(np.int64) % CAP).astype(
+            np.int32
+        )
+        routed = ingest.parallel_host_route(src, dst, 4, key="dst", workers=2)
+        cap = routed.src.shape[1]
+        assert cap & (cap - 1) == 0, "bin arena capacity must be pow2"
+        caps.add(cap)
+    # skews differ wildly but capacities collapse to a handful of buckets
+    assert len(caps) <= 3, caps
+
+
+def test_parallel_host_route_matches_serial():
+    from gelly_streaming_tpu.parallel import routing
+
+    rng = np.random.default_rng(7)
+    for n, shards, key in [(0, 2, "src"), (100, 3, "dst"), (1 << 15, 4, "src")]:
+        src = rng.integers(0, CAP, n).astype(np.int32)
+        dst = ((CAP * rng.random(n) ** 3).astype(np.int64) % CAP).astype(np.int32)
+        serial = routing.host_route(src, dst, shards, key=key)
+        par = ingest.parallel_host_route(src, dst, shards, key=key, workers=2)
+        assert par.src.shape == serial.src.shape
+        assert np.array_equal(par.src, serial.src)
+        assert np.array_equal(par.dst, serial.dst)
+        assert np.array_equal(par.mask, serial.mask)
+
+
+@pytest.mark.timeout_cap(240)
+def test_wire_metrics_counters():
+    src, dst = _edges(8)
+    metrics.reset_wire_stats()
+    _run(ConnectedComponents, src, dst, wire_compress=1)
+    w = metrics.wire_stats()
+    assert w["wire_edges_total"] == N
+    assert w["wire_batches"] == N // BATCH
+    assert w["wire_raw_bytes_total"] == 8 * N
+    assert 0 < w["wire_bytes_total"] < 8 * N
+    assert w["wire_compress_ratio"] > 1.0
+    assert w["wire_bytes_per_edge"] < 8.0
+    assert w["wire_bin_occupancy_hwm"] >= 1
+    metrics.reset_wire_stats()
+    assert metrics.wire_stats()["wire_bytes_total"] == 0
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="binned_ingest"):
+        StreamConfig(binned_ingest=2)
+    with pytest.raises(ValueError, match="wire_compress"):
+        StreamConfig(wire_compress=-2)
+    with pytest.raises(ValueError, match="binned"):
+        StreamConfig(wire_compress=1, binned_ingest=0)
+    with pytest.raises(ValueError, match="2\\^28"):
+        StreamConfig(wire_compress=1, vertex_capacity=1 << 29)
+
+
+def test_env_switch_and_bad_spelling(monkeypatch):
+    cfg = StreamConfig(vertex_capacity=CAP)
+    monkeypatch.delenv("GELLY_WIRE_COMPRESS", raising=False)
+    monkeypatch.delenv("GELLY_BINNED_INGEST", raising=False)
+    assert not wire.resolve_wire_compress(cfg)
+    assert not wire.resolve_binned_ingest(cfg)
+    monkeypatch.setenv("GELLY_WIRE_COMPRESS", "1")
+    assert wire.resolve_wire_compress(cfg)
+    assert wire.resolve_binned_ingest(cfg)  # compression implies binning
+    monkeypatch.setenv("GELLY_WIRE_COMPRESS", "definitely")
+    with pytest.raises(ValueError, match="GELLY_WIRE_COMPRESS"):
+        wire.resolve_wire_compress(cfg)
+    # explicit config wins over the env var
+    monkeypatch.setenv("GELLY_WIRE_COMPRESS", "0")
+    assert wire.resolve_wire_compress(
+        StreamConfig(vertex_capacity=CAP, wire_compress=1)
+    )
+    # ... in BOTH directions: an explicit binned_ingest=0 pins the
+    # arrival-order oracle even when the ambient env asks for compression
+    # (compression implies binning, so it cannot ride either)
+    monkeypatch.setenv("GELLY_WIRE_COMPRESS", "1")
+    pinned = StreamConfig(vertex_capacity=CAP, binned_ingest=0)
+    assert not wire.resolve_binned_ingest(pinned)
+    assert not wire.resolve_wire_compress(pinned)
+
+
+def test_order_sensitive_descriptor_refuses_forced_binning():
+    """Explicit binned_ingest/wire_compress on an order-sensitive fold is a
+    loud error; the ambient env switch quietly stays on the oracle."""
+
+    class OrderSensitive(DegreeDistributionSummary):
+        order_free = False
+
+    src, dst = _edges(9, n=256)
+    cfg = StreamConfig(vertex_capacity=CAP, batch_size=128, wire_compress=1)
+    with pytest.raises(ValueError, match="order-free"):
+        list(OrderSensitive().run(EdgeStream.from_arrays(src, dst, cfg)))
+    os.environ["GELLY_WIRE_COMPRESS"] = "1"
+    try:
+        cfg2 = StreamConfig(vertex_capacity=CAP, batch_size=128)
+        ref_env = list(
+            OrderSensitive().run(EdgeStream.from_arrays(src, dst, cfg2))
+        )
+    finally:
+        del os.environ["GELLY_WIRE_COMPRESS"]
+    ref = list(OrderSensitive().run(EdgeStream.from_arrays(src, dst, cfg2)))
+    _assert_same(ref, ref_env, "env-quiet-fallback")

@@ -1,0 +1,310 @@
+"""BDV (binned delta/varint) wire format: round-trip fuzz vs the oracle.
+
+The compressed ingest path (ISSUE 6) ships (dst, src)-sorted batches as
+interleaved varint streams with a vectorized device decode
+(ops/wire_decode.py).  These tests pin:
+
+  * encode -> host decode round trip == numpy lexsort oracle, across
+    uniform / skewed / clustered / empty / single / max-degree batches;
+  * device decode == host decode bit-exactly (one implementation contract);
+  * native encoder output == numpy fallback bytes;
+  * padding tolerance (trailing zeros decode as dropped groups);
+  * valued (zigzag) round trip;
+  * bucket sizing (bounded shape set, bounded padding overhead).
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.io import wire
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+def _sorted_oracle(src, dst, val=None):
+    order = np.lexsort((src, dst))
+    if val is None:
+        return src[order], dst[order]
+    return src[order], dst[order], val[order]
+
+
+def _gen(kind, n, cap, rng):
+    if kind == "uniform":
+        return (
+            rng.integers(0, cap, n).astype(np.int32),
+            rng.integers(0, cap, n).astype(np.int32),
+        )
+    if kind == "skewed":
+        # hub-heavy destinations + clustered sources (the propagation-
+        # blocking target workload)
+        d = (cap * rng.random(n) ** 4).astype(np.int64).astype(np.int32) % cap
+        s = (cap * rng.random(n) ** 2).astype(np.int64).astype(np.int32) % cap
+        return s, d
+    if kind == "max-degree":
+        # every edge lands on one destination: the worst-case single bin
+        return (
+            np.sort(rng.integers(0, cap, n)).astype(np.int32),
+            np.full(n, cap - 1, np.int32),
+        )
+    if kind == "clustered":
+        block = max(cap // 64, 1)
+        base = rng.integers(0, max(cap - block, 1), n).astype(np.int64)
+        return (
+            (base + rng.integers(0, block, n)).astype(np.int32) % cap,
+            (base + rng.integers(0, block, n)).astype(np.int32) % cap,
+        )
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("kind", ["uniform", "skewed", "max-degree", "clustered"])
+@pytest.mark.parametrize("cap", [1 << 10, 1 << 20, 1 << 28])
+def test_roundtrip_host_and_device(kind, cap):
+    rng = np.random.default_rng(hash((kind, cap)) % (1 << 32))
+    n = 2048
+    src, dst = _gen(kind, n, cap, rng)
+    buf = wire.pack_edges_bdv(src, dst, cap)
+    # bucketed size: a {4..7} * 2^k byte count
+    nb = buf.nbytes
+    k = max(nb.bit_length() - 3, 0)
+    assert nb % (1 << k) == 0 and nb >> k in (4, 5, 6, 7, 8), nb
+    s_h, d_h = wire.unpack_edges_bdv_host(buf, n)
+    s_o, d_o = _sorted_oracle(src, dst)
+    assert np.array_equal(s_h, s_o)
+    assert np.array_equal(d_h, d_o)
+    s_d, d_d = wire.unpack_edges(jnp.asarray(buf), n, (wire.BDV, cap))
+    assert np.array_equal(np.asarray(s_d), s_h)
+    assert np.array_equal(np.asarray(d_d), d_h)
+
+
+def test_roundtrip_fuzz_many_seeds():
+    for seed in range(20):
+        rng = np.random.default_rng(seed)
+        cap = int(rng.integers(2, 1 << 20))
+        n = int(rng.integers(1, 600))
+        src = rng.integers(0, cap, n).astype(np.int32)
+        dst = rng.integers(0, cap, n).astype(np.int32)
+        buf = wire.pack_edges_bdv(src, dst, cap)
+        s_h, d_h = wire.unpack_edges_bdv_host(buf, n)
+        s_o, d_o = _sorted_oracle(src, dst)
+        assert np.array_equal(s_h, s_o) and np.array_equal(d_h, d_o), seed
+
+
+def test_empty_and_single_edge():
+    buf = wire.pack_edges_bdv(
+        np.empty(0, np.int32), np.empty(0, np.int32), 16
+    )
+    s, d = wire.unpack_edges_bdv_host(buf, 0)
+    assert len(s) == 0 and len(d) == 0
+    buf = wire.pack_edges_bdv(
+        np.array([3], np.int32), np.array([9], np.int32), 16
+    )
+    s, d = wire.unpack_edges_bdv_host(buf, 1)
+    assert s.tolist() == [3] and d.tolist() == [9]
+
+
+def test_valued_zigzag_roundtrip():
+    rng = np.random.default_rng(7)
+    n, cap = 1500, 1 << 16
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    val = rng.integers(-(1 << 27), 1 << 27, n).astype(np.int32)
+    buf = wire.pack_edges_bdv(src, dst, cap, val_i32=val)
+    s_h, d_h, v_h = wire.unpack_edges_bdv_host(buf, n, valued=True)
+    s_o, d_o, v_o = _sorted_oracle(src, dst, val)
+    assert np.array_equal(s_h, s_o)
+    assert np.array_equal(d_h, d_o)
+    assert np.array_equal(v_h, v_o)
+    from gelly_streaming_tpu.ops import wire_decode
+
+    s_d, d_d, v_d = wire_decode.decode_bdv(jnp.asarray(buf), n, valued=True)
+    assert np.array_equal(np.asarray(s_d), s_h)
+    assert np.array_equal(np.asarray(d_d), d_h)
+    assert np.array_equal(np.asarray(v_d), v_h)
+
+
+def test_native_and_numpy_encoders_agree():
+    from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+    lib = load_ingest_lib()
+    if lib is None or not hasattr(lib, "encode_edges_bdv"):
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    n, cap = 3000, 1 << 20
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    s_s, d_s, _ = wire._sort_edges_bdv(src, dst, cap)
+    numpy_payload = wire._encode_bdv_np(s_s, d_s)
+    buf = wire.pack_edges_bdv(src, dst, cap)  # native encoder path
+    assert np.array_equal(buf[: len(numpy_payload)], numpy_payload)
+    assert not buf[len(numpy_payload) :].any()
+
+
+def test_native_sort_matches_lexsort():
+    from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+    lib = load_ingest_lib()
+    if lib is None or not hasattr(lib, "sort_edges_dst_src"):
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(4)
+    for cap in (2, 1 << 8, 1 << 20):
+        n = 4000
+        src = rng.integers(0, cap, n).astype(np.int32)
+        dst = rng.integers(0, cap, n).astype(np.int32)
+        s, d, _ = wire._sort_edges_bdv(src, dst, cap)
+        order = np.lexsort((src, dst))
+        assert np.array_equal(s, src[order])
+        assert np.array_equal(d, dst[order])
+
+
+def test_padding_tolerance():
+    """Trailing zero bytes (bucket padding, superbatch group max-padding)
+    decode as dropped empty varint groups — same edges out."""
+    rng = np.random.default_rng(5)
+    n, cap = 513, 1 << 14
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    buf = wire.pack_edges_bdv(src, dst, cap)
+    padded = np.zeros(buf.nbytes + 4096, np.uint8)
+    padded[: buf.nbytes] = buf
+    s1, d1 = wire.unpack_edges_bdv_host(buf, n)
+    s2, d2 = wire.unpack_edges_bdv_host(padded, n)
+    assert np.array_equal(s1, s2) and np.array_equal(d1, d2)
+    s3, d3 = wire.unpack_edges(jnp.asarray(padded), n, (wire.BDV, cap))
+    assert np.array_equal(np.asarray(s3), s1)
+    assert np.array_equal(np.asarray(d3), d1)
+
+
+def test_capacity_bound_refused():
+    with pytest.raises(ValueError, match="BDV"):
+        wire.pack_edges_bdv(
+            np.array([0], np.int32), np.array([0], np.int32), 1 << 29
+        )
+
+
+def test_varint_boundaries():
+    vals = np.array(
+        [0, 1, 127, 128, 16383, 16384, (1 << 21) - 1, 1 << 21, (1 << 28) - 1],
+        np.uint64,
+    )
+    enc = wire._varint_encode_np(vals)
+    dec = wire._varint_decode_np(enc, len(vals))
+    assert np.array_equal(dec, vals.astype(np.int64))
+    from gelly_streaming_tpu.ops import wire_decode
+
+    dev = wire_decode.decode_varints(jnp.asarray(enc), len(vals))
+    assert np.array_equal(np.asarray(dev).astype(np.int64), vals.astype(np.int64))
+
+
+def test_wire_nbytes_and_pack_edges_dispatch():
+    rng = np.random.default_rng(6)
+    n, cap = 256, 1 << 12
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    width = (wire.BDV, cap)
+    buf = wire.pack_edges(src, dst, width)
+    assert buf.nbytes <= wire.wire_nbytes(n, width)
+    s, d = wire.unpack_edges_host(buf, n, width)
+    s_o, d_o = _sorted_oracle(src, dst)
+    assert np.array_equal(s, s_o) and np.array_equal(d, d_o)
+    # fixed-slice arena packing has no contract for variable-size buffers
+    with pytest.raises(ValueError, match="variable-size"):
+        wire.pack_edges_into(src, dst, width, np.zeros(64, np.uint8))
+
+
+def test_from_wire_bdv_replay():
+    """BDV replay buffers stream through EdgeStream.from_wire: the fast
+    path consumes them transfer-only and the host decode serves every
+    other consumer; out-of-range ids are smoke-checked up front."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+
+    rng = np.random.default_rng(8)
+    cap = 1 << 12
+    n, batch = 2048, 512
+    src = rng.integers(0, cap, n).astype(np.int32)
+    dst = rng.integers(0, cap, n).astype(np.int32)
+    cfg = StreamConfig(vertex_capacity=cap, batch_size=batch)
+    width = (wire.BDV, cap)
+    bufs, tail = wire.pack_stream(src, dst, batch, width)
+    stream = EdgeStream.from_wire(bufs, batch, width, cfg, tail=tail)
+    got = list(ConnectedComponents().run(stream))
+    ref = list(
+        ConnectedComponents().run(EdgeStream.from_arrays(src, dst, cfg))
+    )
+    assert len(got) == len(ref) == 1
+    assert np.array_equal(np.asarray(got[0][0].parent), np.asarray(ref[0][0].parent))
+    assert np.array_equal(np.asarray(got[0][0].seen), np.asarray(ref[0][0].seen))
+    # capacity beyond the config is refused outright
+    with pytest.raises(ValueError, match="BDV width capacity"):
+        EdgeStream.from_wire([], batch, (wire.BDV, 1 << 20), cfg)
+    # ids beyond vertex_capacity are smoke-checked on the first buffer
+    small = StreamConfig(vertex_capacity=8, batch_size=4)
+    bad = wire.pack_edges_bdv(
+        np.array([9] * 4, np.int32), np.array([1] * 4, np.int32), 1 << 8
+    )
+    with pytest.raises(ValueError, match="decodes vertex ids"):
+        EdgeStream.from_wire([bad], 4, (wire.BDV, 8), small)
+
+
+def test_worst_case_payload_clamps_at_wire_nbytes():
+    """A near-worst-case batch (huge dst deltas, alternating-sign src
+    deltas) must never bucket-pad PAST the documented ``wire_nbytes``
+    ceiling: from_wire and the mesh replay arenas size buffers by it."""
+    n, cap = 16, 1 << 28
+    dst = (np.arange(n, dtype=np.int64) * (1 << 24)).astype(np.int32)
+    src = np.where(np.arange(n) % 2, 1 << 27, 0).astype(np.int32)
+    width = (wire.BDV, cap)
+    buf = wire.pack_edges_bdv(src, dst, cap)
+    assert buf.nbytes <= wire.wire_nbytes(n, width), buf.nbytes
+    s, d = wire.unpack_edges_bdv_host(buf, n)
+    s_o, d_o = _sorted_oracle(src, dst)
+    assert np.array_equal(s, s_o) and np.array_equal(d, d_o)
+    # and the producer's own buffer passes from_wire's validation
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    cfg = StreamConfig(vertex_capacity=cap, batch_size=n)
+    stream = EdgeStream.from_wire([buf], n, width, cfg)
+    assert stream is not None
+
+
+def test_truncated_buffer_refused():
+    """The host decode is the validation front door: a buffer shorter than
+    its control block (or the payload the control block declares) raises a
+    clean ValueError instead of an IndexError — including through
+    from_wire's smoke guard."""
+    with pytest.raises(ValueError, match="truncated"):
+        wire.unpack_edges_bdv_host(np.zeros(8, np.uint8), 1024)
+    # control block present but declaring more payload than the buffer holds
+    with pytest.raises(ValueError, match="truncated"):
+        wire.unpack_edges_bdv_host(np.full(3, 0xFF, np.uint8), 4)
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    cfg = StreamConfig(vertex_capacity=1 << 20, batch_size=1024)
+    with pytest.raises(ValueError, match="truncated"):
+        EdgeStream.from_wire(
+            [np.zeros(8, np.uint8)], 1024, (wire.BDV, 1 << 20), cfg
+        )
+
+
+def test_negative_decoded_ids_refused():
+    """BDV is the one wire format whose signed zigzag src deltas can decode
+    NEGATIVE ids; a negative scatter index wraps to the end of the summary
+    arrays, so from_wire's smoke guard must refuse both range ends."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+
+    # stream = [dst_delta=0, zigzag(src_delta=-1)=1] -> decodes (src=-1, dst=0)
+    payload = wire._varint_encode_np(np.array([0, 1], np.uint64))
+    buf = np.zeros(wire.bdv_bucket_nbytes(len(payload)), np.uint8)
+    buf[: len(payload)] = payload
+    s, d = wire.unpack_edges_bdv_host(buf, 1)
+    assert s.tolist() == [-1] and d.tolist() == [0]
+    cfg = StreamConfig(vertex_capacity=1 << 12, batch_size=1)
+    with pytest.raises(ValueError, match="outside"):
+        EdgeStream.from_wire([buf], 1, (wire.BDV, 1 << 12), cfg)
