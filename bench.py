@@ -474,6 +474,196 @@ def _multi_tenant_bench(
     return out
 
 
+def _serving_bench(
+    clients=(1, 4, 16), windows: int = 16, win_edges: int = 1 << 12,
+    capacity: int = 1 << 14,
+):
+    """Streaming RPC serving plane sweep (ISSUE 8): connection scaling.
+
+    For each client count k, k threads each open their own connection to a
+    loopback StreamServer, submit a same-shape streaming-CC job, push the
+    edge stream as BDV-compressed wire batches, and consume the emission
+    records.  Reported: aggregate eps per client count, p50/p99
+    submit-to-first-emission latency across every client, the
+    server-vs-in-process throughput ratio at 4 clients (the serving tax:
+    framing + sockets + the results plane over the same scheduler), and
+    the per-tenant ingest ledger beside it.
+    """
+    import threading
+
+    from gelly_streaming_tpu.core.config import (
+        RuntimeConfig,
+        ServerConfig,
+        StreamConfig,
+    )
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.core.types import EdgeBatch
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.runtime.client import GellyClient
+    from gelly_streaming_tpu.runtime.server import StreamServer
+    from gelly_streaming_tpu.utils import metrics
+
+    if windows < 2:
+        # the first-emission probe pushes one window plus its closing
+        # boundary batch; a single-window stream would never close it
+        raise ValueError("serving bench needs windows >= 2")
+    n = windows * win_edges
+    bs = win_edges // 2
+    cfg = StreamConfig(
+        vertex_capacity=capacity, batch_size=bs, ingest_window_edges=win_edges
+    )
+    rng = np.random.default_rng(17)
+    max_k = max(clients)
+    datasets = [
+        (
+            rng.integers(0, capacity, n).astype(np.int32),
+            rng.integers(0, capacity, n).astype(np.int32),
+        )
+        for _ in range(max_k)
+    ]
+
+    # in-process baseline over the SAME plane the remote jobs ride (the
+    # windowed ingestion-pane runtime over decoded batches), 4 jobs
+    def batches_stream(i):
+        s, d = datasets[i]
+
+        def factory():
+            for o in range(0, n, bs):
+                yield EdgeBatch.from_arrays(
+                    s[o : o + bs], d[o : o + bs], pad_to=bs
+                )
+
+        return EdgeStream.from_batches(factory, cfg)
+
+    def inproc_run(k):
+        with JobManager(RuntimeConfig(max_jobs=max(8, k))) as jm:
+            jobs = [
+                jm.submit_aggregation(
+                    batches_stream(i),
+                    ConnectedComponents(),
+                    name=f"inproc-{k}-{i}",
+                    sink=lambda rec: np.asarray(rec[0].parent),
+                )
+                for i in range(k)
+            ]
+            t0 = time.perf_counter()
+            jm.wait_all()
+            del jobs
+            return k * n / (time.perf_counter() - t0)
+
+    inproc_run(4)  # warmup: compiles land here
+    inproc_eps_4 = inproc_run(4)
+
+    metrics.reset_tenant_stats()
+    out = {"serving_inprocess_eps_4": round(inproc_eps_4, 1)}
+    latencies = []
+    for k in clients:
+        first_emit = {}
+        errors = []
+        with JobManager(
+            RuntimeConfig(max_jobs=max(8, k))
+        ) as jm, StreamServer(jm, ServerConfig()) as server:
+
+            def run_client(i):
+                try:
+                    s, d = datasets[i]
+                    with GellyClient("127.0.0.1", server.port) as c:
+                        name = f"cc-{k}x-{i}"
+                        t_submit = time.perf_counter()
+                        c.submit(
+                            name=name,
+                            query="cc",
+                            capacity=capacity,
+                            window_edges=win_edges,
+                            batch=bs,
+                        )
+                        # first window + its closing boundary, then wait
+                        # for the first emission: submit-to-first-emission
+                        # measures the serving plane's latency floor, not
+                        # the wall time of pushing the whole stream
+                        head = win_edges + bs
+                        c.push_edges(
+                            name, s[:head], d[:head], batch=bs,
+                            capacity=capacity, bdv=True, close=False,
+                        )
+                        probe_deadline = time.monotonic() + 120
+                        while True:
+                            recs, state, eos = c.results(
+                                name, timeout_ms=5_000
+                            )
+                            if recs:
+                                first_emit[i] = (
+                                    time.perf_counter() - t_submit
+                                )
+                                break
+                            if eos or state in ("FAILED", "CANCELLED"):
+                                raise RuntimeError(
+                                    f"{name} ended ({state}) before its "
+                                    "first emission"
+                                )
+                            if time.monotonic() > probe_deadline:
+                                raise RuntimeError(
+                                    f"{name} produced no first emission "
+                                    "within 120s"
+                                )
+                        c.push_edges(
+                            name, s, d, batch=bs, capacity=capacity,
+                            bdv=True, start=head,
+                        )
+                        for _rec in c.iter_results(name, deadline_s=600):
+                            pass
+                except BaseException as e:
+                    errors.append(e)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=run_client, args=(i,))
+                for i in range(k)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        out[f"serving_eps_{k}"] = round(k * n / wall, 1)
+        latencies.extend(first_emit.values())
+    lat_ms = sorted(1e3 * x for x in latencies)
+    out["serving_submit_to_first_emission_p50_ms"] = round(
+        lat_ms[len(lat_ms) // 2], 1
+    )
+    out["serving_submit_to_first_emission_p99_ms"] = round(
+        lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))], 1
+    )
+    out["serving_vs_inprocess_ratio_4"] = round(
+        out["serving_eps_4"] / inproc_eps_4, 3
+    )
+    totals = metrics.tenant_totals()
+    out.update(
+        {
+            f"serving_{key}": totals[key]
+            for key in (
+                "tenant_requests",
+                "tenant_ingest_edges",
+                "tenant_ingest_wire_bytes",
+                "tenant_ingest_raw_bytes",
+                "tenant_admission_rejections",
+                "tenant_ingest_queue_hwm",
+            )
+        }
+    )
+    out["serving_wire_bytes_per_edge"] = round(
+        totals["tenant_ingest_wire_bytes"]
+        / max(totals["tenant_ingest_edges"], 1),
+        3,
+    )
+    return out
+
+
 _PARTIAL = {}  # best results so far, emitted by the deadline watchdog
 
 
@@ -1159,6 +1349,35 @@ def main():
             )
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"multi-tenant bench skipped: {e}", file=sys.stderr)
+
+    # ---- streaming RPC serving plane: clients in {1, 4, 16} over loopback --
+    # (ISSUE 8 acceptance: connection-scaling eps and p50/p99
+    # submit-to-first-emission latency, plus the server-vs-in-process ratio)
+    try:
+        if os.environ.get("GELLY_BENCH_SERVING", "1") != "0":
+            serving_stats = _serving_bench(
+                windows=int(os.environ.get("GELLY_BENCH_SERVING_WINDOWS", 16)),
+                win_edges=int(
+                    os.environ.get("GELLY_BENCH_SERVING_WIN_EDGES", 1 << 12)
+                ),
+            )
+            _PARTIAL.update(serving_stats)
+            print(
+                f"serving: 1/4/16 clients "
+                f"{serving_stats['serving_eps_1'] / 1e6:.2f}/"
+                f"{serving_stats['serving_eps_4'] / 1e6:.2f}/"
+                f"{serving_stats['serving_eps_16'] / 1e6:.2f}M eps aggregate"
+                f" (x{serving_stats['serving_vs_inprocess_ratio_4']} of "
+                f"in-process at 4), submit->first-emission p50/p99 "
+                f"{serving_stats['serving_submit_to_first_emission_p50_ms']}/"
+                f"{serving_stats['serving_submit_to_first_emission_p99_ms']}"
+                f" ms, "
+                f"{serving_stats['serving_wire_bytes_per_edge']} B/e on the "
+                "socket",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"serving bench skipped: {e}", file=sys.stderr)
 
     # ---- static-analysis attestation: the artifact doubles as a proof the
     # measured tree passes graftcheck (0 = clean; a positive count means the

@@ -144,8 +144,21 @@ class LockDisciplinePass(analysis.Pass):
                             held.add(("self", ctx.attr))
                         elif isinstance(ctx, ast.Name):
                             held.add(("global", ctx.id))
+                    # route the body back through THIS dispatch (wrapped so
+                    # each statement is seen as a child), not check(stmt)
+                    # directly: a statement that is itself a With (a nested
+                    # `with self._lock:` inside another with), a def, or a
+                    # class needs its special handling, which dispatches on
+                    # the PARENT's iteration — calling check(stmt) on it
+                    # would skip lock collection for the nested with's body
+                    check(
+                        ast.Module(body=list(child.body), type_ignores=[]),
+                        cls,
+                        func_depth,
+                        held,
+                        single,
+                    )
                     for stmt in child.body:
-                        check(stmt, cls, func_depth, held, single)
                         _inspect(stmt, cls, func_depth, held, single)
                     continue
                 _inspect(child, cls, func_depth, locks, single)

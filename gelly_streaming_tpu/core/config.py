@@ -237,3 +237,119 @@ class RuntimeConfig:
             raise ValueError("fair_quantum must be positive")
         if self.keep_terminal_jobs < 0:
             raise ValueError("keep_terminal_jobs must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One tenant of the streaming RPC serving plane (runtime/server.py).
+
+    ``RuntimeConfig`` caps the PROCESS; a tenant is a slice of it.  The
+    serving plane authenticates every request by token, then enforces the
+    tenant's own admission caps on top of the manager's global ones and
+    multiplies the tenant's ``weight`` into every job weight it submits —
+    priorities ride the existing weighted-fair scheduler rather than a
+    second queueing tier.
+
+    Attributes:
+      tenant: tenant id (job names are scoped per tenant).
+      token: shared-secret auth token carried on every request frame.  An
+        empty token is only legal in OPEN mode (a server configured with
+        zero tenants runs a single implicit open tenant).
+      max_jobs: concurrent non-terminal jobs this tenant may hold
+        (0 = no per-tenant cap; the global ``RuntimeConfig.max_jobs``
+        still applies).
+      max_state_bytes: aggregate admitted summary-state bytes across this
+        tenant's live jobs (0 = uncapped below the global cap).
+      max_ingest_bps: wire bytes/second of network edge ingest this tenant
+        may push (token bucket; 0 = unlimited).  Enforced by throttling the
+        pushing CONNECTION — the backpressure lands on that tenant's
+        socket, never on the scheduler or other tenants.
+      weight: scheduler priority multiplier: an admitted job runs at
+        ``tenant.weight * job.weight`` in the weighted-fair rounds.
+    """
+
+    tenant: str = "default"
+    token: str = ""
+    max_jobs: int = 0
+    max_state_bytes: int = 0
+    max_ingest_bps: int = 0
+    weight: int = 1
+
+    def __post_init__(self):
+        if not self.tenant:
+            raise ValueError("tenant id must be non-empty")
+        if self.max_jobs < 0:
+            raise ValueError("tenant max_jobs must be >= 0 (0 = uncapped)")
+        if self.max_state_bytes < 0:
+            raise ValueError("tenant max_state_bytes must be >= 0")
+        if self.max_ingest_bps < 0:
+            raise ValueError("tenant max_ingest_bps must be >= 0")
+        if self.weight <= 0:
+            raise ValueError("tenant weight must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """Knobs for the streaming RPC server (runtime/server.py).
+
+    The serving plane's load is connections and request frames, not a local
+    file — these caps bound what one listener process will accept.  Frame
+    and queue limits are refusal boundaries (a too-large frame gets a clean
+    error frame, an over-quota submit an admission error), never silent
+    growth.
+
+    Attributes:
+      host/port: listen address; port 0 binds an ephemeral port (read it
+        back from ``StreamServer.port``).
+      tenants: authenticated tenants.  Empty = OPEN mode: one implicit
+        ``default`` tenant with no token and no per-tenant caps (tests,
+        loopback benches).  Tokens and tenant ids must be unique.
+      max_frame_bytes: hard cap on one frame's binary payload; oversized
+        frames are refused with an error frame and the connection closed
+        (the stream cannot be resynced past an unread giant payload).
+      max_connections: concurrent client connections; excess accepts are
+        refused with an error frame.
+      ingest_queue_batches: per-source bounded queue of decoded wire
+        batches between a pushing connection and its job — the network
+        isolation boundary: a full queue blocks THAT connection's reader
+        (TCP backpressure to that client), never the scheduler.
+      result_buffer_records: per-job buffered emissions awaiting a
+        ``results`` fetch; a full buffer blocks that job's sink pump (its
+        bounded emission queue then skips only that job's rounds).
+      checkpoint_prefix: when set, jobs submitted with ``checkpoint: true``
+        get per-(tenant, job) snapshot files derived from this prefix
+        (utils.checkpoint.per_job_file) — the durable state that makes
+        drain/restart resume bit-exactly.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    tenants: tuple = ()
+    max_frame_bytes: int = 1 << 26
+    max_connections: int = 64
+    ingest_queue_batches: int = 64
+    result_buffer_records: int = 1024
+    checkpoint_prefix: "str | None" = None
+
+    def __post_init__(self):
+        if not (0 <= self.port <= 65535):
+            raise ValueError("port must be in [0, 65535]")
+        if self.max_frame_bytes < (1 << 12):
+            raise ValueError("max_frame_bytes must be >= 4096")
+        if self.max_connections <= 0:
+            raise ValueError("max_connections must be positive")
+        if self.ingest_queue_batches <= 0:
+            raise ValueError("ingest_queue_batches must be positive")
+        if self.result_buffer_records <= 0:
+            raise ValueError("result_buffer_records must be positive")
+        ids = [t.tenant for t in self.tenants]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate tenant ids: {sorted(ids)}")
+        tokens = [t.token for t in self.tenants]
+        if len(set(tokens)) != len(tokens):
+            raise ValueError("tenant tokens must be unique")
+        if self.tenants and any(not t.token for t in self.tenants):
+            raise ValueError(
+                "configured tenants need non-empty tokens (an empty token "
+                "is only legal in open mode: tenants=())"
+            )

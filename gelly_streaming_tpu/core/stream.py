@@ -204,6 +204,104 @@ def plan_superbatch_groups(n: int, k: int, boundaries=()) -> List[int]:
 
 
 # ---------------------------------------------------------------------------
+# wire-buffer validation (the from_wire guards, shared with the network
+# ingest plane)
+# ---------------------------------------------------------------------------
+
+
+def validate_wire_width(width, capacity: int) -> None:
+    """The ``from_wire`` width guards as a reusable check: the encoding must
+    be a supported one, and a tuple width's claimed capacity must not exceed
+    the stream's (decoded ids could reach or pass it and silently corrupt
+    device state)."""
+    from ..io import wire as _wire
+
+    if width not in (2, 3, 4, _wire.PAIR40) and not (
+        isinstance(width, tuple)
+        and len(width) == 2
+        and width[0] in (_wire.EF40, _wire.BDV)
+    ):
+        raise ValueError(f"unsupported wire width {width}")
+    if isinstance(width, tuple) and width[1] > capacity:
+        raise ValueError(
+            f"{width[0].upper()} width capacity {width[1]} exceeds "
+            f"cfg.vertex_capacity {capacity}: decoded ids could reach or "
+            "pass it and silently corrupt device state; "
+            "intern ids first (io.interning.VertexInterner)"
+        )
+
+
+def validate_wire_buffer(
+    buf,
+    batch_size: int,
+    width,
+    capacity: int,
+    index: int = 0,
+    decode_ids: bool = False,
+):
+    """One buffer's worth of the ``from_wire`` guards: dtype, size bounds
+    (exact for fixed widths, [floor, worst-case] for the data-dependent BDV
+    sizes), and — with ``decode_ids`` — a host decode with both ends of the
+    id range checked (BDV's signed zigzag deltas can express NEGATIVE ids,
+    whose scatters silently wrap to the summary tail).
+
+    ``from_wire`` applies the decode check to buffer 0 only (replay
+    producers are trusted — see its docstring); the network ingest plane
+    (io/sources.NetworkEdgeSource) applies it to EVERY pushed buffer, since
+    the socket is the trust boundary.  Returns the decoded ``(src, dst)``
+    arrays when ``decode_ids`` (the caller was going to decode anyway),
+    else None.
+    """
+    from ..io import wire as _wire
+
+    b = np.asarray(buf)
+    if b.dtype != np.uint8:
+        # a same-nbytes buffer of another dtype would sign-extend /
+        # mis-slice in the device decode — wire bytes are uint8
+        raise ValueError(f"wire buffer {index} has dtype {b.dtype}, not uint8")
+    expect = _wire.wire_nbytes(batch_size, width)
+    is_bdv = isinstance(width, tuple) and width[0] == _wire.BDV
+    if is_bdv:
+        # BDV buffers are data-dependent sizes under the worst-case bound
+        # (delta/varint payload + bucket padding); the floor is the control
+        # block + one byte per varint — shorter buffers cannot hold
+        # batch_size edges, and the device decoder's clipped gathers would
+        # silently read garbage instead of raising (devices cannot)
+        bdv_min = (2 * batch_size + 3) // 4 + 2 * batch_size
+        if b.nbytes > expect:
+            raise ValueError(
+                f"BDV wire buffer {index} holds {b.nbytes} bytes; "
+                f"batch_size={batch_size} caps at {expect}"
+            )
+        if b.nbytes < bdv_min:
+            raise ValueError(
+                f"BDV wire buffer {index} holds {b.nbytes} bytes, "
+                f"truncated below the {bdv_min}-byte minimum for "
+                f"batch_size={batch_size}"
+            )
+    elif b.nbytes != expect:
+        raise ValueError(
+            f"wire buffer {index} holds {b.nbytes} bytes; "
+            f"batch_size={batch_size} at width {width} needs {expect}"
+        )
+    if not decode_ids:
+        return None
+    from ..io.wire import unpack_edges_host as _unpack
+
+    s, d = _unpack(b, batch_size, width)
+    if len(s) and (
+        int(min(s.min(), d.min())) < 0
+        or int(max(s.max(), d.max())) >= capacity
+    ):
+        raise ValueError(
+            f"wire buffer {index} decodes vertex ids outside "
+            f"[0, vertex_capacity {capacity}); intern ids first "
+            "(io.interning.VertexInterner)"
+        )
+    return s, d
+
+
+# ---------------------------------------------------------------------------
 # EdgeStream
 # ---------------------------------------------------------------------------
 
@@ -396,86 +494,31 @@ class EdgeStream:
         bufs = list(bufs)
         from ..io import wire as _wire
 
-        if width not in (2, 3, 4, _wire.PAIR40) and not (
-            isinstance(width, tuple)
-            and len(width) == 2
-            and width[0] in (_wire.EF40, _wire.BDV)
-        ):
-            raise ValueError(f"unsupported wire width {width}")
+        validate_wire_width(width, cfg.vertex_capacity)
         cap = cfg.vertex_capacity
         is_bdv = isinstance(width, tuple) and width[0] == _wire.BDV
-        if isinstance(width, tuple) and width[1] > cap:
-            raise ValueError(
-                f"{width[0].upper()} width capacity {width[1]} exceeds "
-                f"cfg.vertex_capacity {cap}: decoded ids could reach or "
-                "pass it and silently corrupt device state; "
-                "intern ids first (io.interning.VertexInterner)"
-            )
-        expect = _wire.wire_nbytes(batch_size, width)
-        # the 1-byte-per-value floor: control block + one byte per varint.
-        # Shorter buffers cannot hold batch_size edges, and the device
-        # decoder's clipped gathers would silently read garbage instead of
-        # raising (devices cannot) — so the lower bound is checked PER
-        # buffer, like the exact size is for fixed widths
-        bdv_min = (2 * batch_size + 3) // 4 + 2 * batch_size
         for i, b in enumerate(bufs):
-            b = np.asarray(b)
-            if b.dtype != np.uint8:
-                # a same-nbytes buffer of another dtype would sign-extend /
-                # mis-slice in the device decode — wire bytes are uint8
-                raise ValueError(f"wire buffer {i} has dtype {b.dtype}, not uint8")
-            if is_bdv:
-                # BDV buffers are data-dependent sizes under the worst-case
-                # bound (delta/varint payload + bucket padding)
-                if b.nbytes > expect:
-                    raise ValueError(
-                        f"BDV wire buffer {i} holds {b.nbytes} bytes; "
-                        f"batch_size={batch_size} caps at {expect}"
-                    )
-                if b.nbytes < bdv_min:
-                    raise ValueError(
-                        f"BDV wire buffer {i} holds {b.nbytes} bytes, "
-                        f"truncated below the {bdv_min}-byte minimum for "
-                        f"batch_size={batch_size}"
-                    )
-            elif b.nbytes != expect:
-                raise ValueError(
-                    f"wire buffer {i} holds {b.nbytes} bytes; "
-                    f"batch_size={batch_size} at width {width} needs {expect}"
-                )
+            validate_wire_buffer(b, batch_size, width, cap, index=i)
         if is_bdv and bufs:
-            # varints can express ids past the claimed capacity: decode the
-            # FIRST buffer as a smoke guard (full validation of every
-            # buffer is the producer's contract, as for fixed widths)
-            s0, d0 = _wire.unpack_edges_host(np.asarray(bufs[0]), batch_size, width)
-            # BDV is the one wire format that can decode NEGATIVE ids
-            # (signed zigzag src deltas), and a negative scatter index
-            # silently wraps to the end of the summary arrays — guard both
-            # ends of the range, like the tail-ids check below
-            if len(s0) and (
-                int(min(s0.min(), d0.min())) < 0
-                or int(max(s0.max(), d0.max())) >= cap
-            ):
-                raise ValueError(
-                    f"wire buffer 0 decodes vertex ids outside "
-                    f"[0, vertex_capacity {cap}); intern ids first "
-                    "(io.interning.VertexInterner)"
-                )
+            # varints can express ids past the claimed capacity (and BDV's
+            # signed zigzag src deltas can even express NEGATIVE ids, whose
+            # scatters silently wrap to the end of the summary arrays):
+            # decode the FIRST buffer as a smoke guard checking both ends
+            # (full validation of every buffer is the producer's contract,
+            # as for fixed widths; the network ingest plane — where the
+            # producer is untrusted — checks every pushed buffer instead)
+            validate_wire_buffer(
+                bufs[0], batch_size, width, cap, index=0, decode_ids=True
+            )
         if not isinstance(width, tuple):
             # fixed-width encodings can express ids beyond vertex_capacity;
             # decode the FIRST buffer as a smoke guard (full validation is
             # the producer's contract — see docstring)
             id_bound = (1 << 20) if width == _wire.PAIR40 else (1 << (8 * width))
             if id_bound > cap and bufs:
-                s0, d0 = _wire.unpack_edges_host(
-                    np.asarray(bufs[0]), batch_size, width
+                validate_wire_buffer(
+                    bufs[0], batch_size, width, cap, index=0, decode_ids=True
                 )
-                if len(s0) and int(max(s0.max(), d0.max())) >= cap:
-                    raise ValueError(
-                        f"wire buffer 0 decodes vertex ids >= "
-                        f"vertex_capacity {cap}; intern ids first "
-                        "(io.interning.VertexInterner)"
-                    )
         if tail is not None:
             t_src0 = np.asarray(tail[0])
             t_dst0 = np.asarray(tail[1])

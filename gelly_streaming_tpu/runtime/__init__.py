@@ -13,9 +13,29 @@ Public surface::
 See runtime/job.py for the lifecycle state machine and runtime/manager.py
 for the weighted-fair cooperative scheduler + admission control;
 ``gelly-serve`` (runtime/serve.py) is the console driver.
+
+The network layer on top (ISSUE 8)::
+
+    from gelly_streaming_tpu.runtime import StreamServer
+    from gelly_streaming_tpu.runtime.client import GellyClient
+
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as server:
+        with GellyClient("127.0.0.1", server.port) as client:
+            client.submit(name="cc", query="cc", window_edges=1 << 13)
+            client.push_edges("cc", src, dst, batch=1 << 12,
+                              capacity=1 << 16)
+            for record in client.iter_results("cc"):
+                ...
+
+``gelly-serve --listen host:port`` runs the long-lived server;
+``gelly-client`` is the remote console (runtime/client.py).
 """
 
-from gelly_streaming_tpu.core.config import RuntimeConfig
+from gelly_streaming_tpu.core.config import (
+    RuntimeConfig,
+    ServerConfig,
+    TenantConfig,
+)
 from gelly_streaming_tpu.runtime.job import (
     AdmissionError,
     Job,
@@ -24,6 +44,17 @@ from gelly_streaming_tpu.runtime.job import (
 )
 from gelly_streaming_tpu.runtime.manager import JobManager
 
+
+def __getattr__(name):
+    # StreamServer drags in the full server module (sockets, selectors);
+    # keep `from gelly_streaming_tpu.runtime import JobManager` light
+    if name == "StreamServer":
+        from gelly_streaming_tpu.runtime.server import StreamServer
+
+        return StreamServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "AdmissionError",
     "Job",
@@ -31,4 +62,7 @@ __all__ = [
     "JobManager",
     "JobState",
     "RuntimeConfig",
+    "ServerConfig",
+    "StreamServer",
+    "TenantConfig",
 ]

@@ -1,0 +1,438 @@
+"""``gelly-client``: the remote side of the streaming RPC serving plane.
+
+``GellyClient`` is the programmatic API (one socket, synchronous
+request/reply frames — runtime/protocol.py); ``main`` is the console
+script: submit / status / push-edges / results / drain / cancel against a
+``gelly-serve --listen`` server.
+
+Edges cross the socket in the framework's own wire encodings: the client
+packs micro-batches with io/wire.py (fixed-width, or BDV delta/varint at
+~2.7 B/edge when the server's submit reply advertises ``accept_bdv``), so
+the link cost is the PR-6 compressed format, not 8-byte id pairs.
+Emission records come back as their flattened array leaves (one ``.npz``
+payload per ``results`` reply) — bit-identical to what an in-process
+sink's ``jax.tree.leaves`` would see, which is exactly what the
+equivalence tests compare.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io as _io
+import socket
+import sys
+import time
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from gelly_streaming_tpu.runtime import protocol
+
+
+class ClientError(RuntimeError):
+    """Transport-level failure (connection closed, bad frame)."""
+
+
+class ServerRefused(RuntimeError):
+    """The server answered with ``ok: false``; carries the typed code."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class GellyClient:
+    """One connection to a StreamServer.  Thread-compatible, not
+    thread-safe: use one client per pushing thread (that is also what
+    keeps per-connection backpressure per-client)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        token: str = "",
+        timeout: Optional[float] = 120.0,
+    ):
+        self.token = token
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        try:
+            # request/reply framing: Nagle + delayed ACK would add ~40 ms
+            # to every small frame round trip
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._f = self._sock.makefile("rwb")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "GellyClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- frame plumbing ------------------------------------------------------
+
+    def call_raw(
+        self, header: dict, payload: bytes = b""
+    ) -> Tuple[dict, bytes]:
+        """One request/reply round trip; raises ``ClientError`` on
+        transport failure, returns the reply even when ``ok`` is false."""
+        header = dict(header)
+        header.setdefault("token", self.token)
+        try:
+            protocol.write_frame(self._f, header, payload)
+            reply = protocol.read_frame(self._f)
+        except (OSError, protocol.ProtocolError) as e:
+            raise ClientError(f"transport failure: {e}") from e
+        if reply is None:
+            raise ClientError("server closed the connection")
+        return reply
+
+    def call(self, header: dict, payload: bytes = b"") -> Tuple[dict, bytes]:
+        """``call_raw`` + refusal handling: ``ok: false`` raises
+        ``ServerRefused(code)``."""
+        head, pay = self.call_raw(header, payload)
+        if not head.get("ok"):
+            raise ServerRefused(
+                head.get("code", "error"), head.get("error", "refused")
+            )
+        return head, pay
+
+    # -- verbs ---------------------------------------------------------------
+
+    def ping(self) -> dict:
+        return self.call({"verb": "ping"})[0]
+
+    def submit(self, **spec) -> dict:
+        """Submit a job spec; returns the reply (``resume_edges`` is the
+        cursor to push from for checkpointed jobs)."""
+        return self.call({"verb": "submit", "spec": spec})[0]
+
+    def push_wire(self, job: str, buf, kind: str = "wire") -> dict:
+        return self.call(
+            {"verb": "push", "job": job, "kind": kind},
+            np.ascontiguousarray(buf, np.uint8).tobytes(),
+        )[0]
+
+    def push_tail(self, job: str, src, dst) -> dict:
+        src = np.ascontiguousarray(src, "<i4")
+        dst = np.ascontiguousarray(dst, "<i4")
+        return self.call(
+            {"verb": "push", "job": job, "kind": "tail", "count": len(src)},
+            src.tobytes() + dst.tobytes(),
+        )[0]
+
+    def eos(self, job: str) -> dict:
+        return self.call({"verb": "eos", "job": job})[0]
+
+    def push_edges(
+        self,
+        job: str,
+        src,
+        dst,
+        batch: int,
+        capacity: int,
+        bdv: bool = False,
+        start: int = 0,
+        close: bool = True,
+        window: int = 32,
+    ) -> int:
+        """Pack ``src/dst[start:]`` into full wire batches (+ raw tail) and
+        push them, optionally closing the stream.  Returns edges pushed.
+
+        ``start`` is the resume cursor from ``submit`` — on reconnect the
+        client ships only the suffix the server's checkpoint doesn't cover.
+
+        Push frames are PIPELINED: up to ``window`` frames are written
+        before their replies are read (replies come back in order — the
+        server handles one connection's frames sequentially), so the
+        socket round trip is paid once per window, not once per batch,
+        while the bounded reply window still surfaces refusals promptly
+        and keeps the server's per-connection backpressure effective.
+        """
+        from gelly_streaming_tpu.io import wire as wire_mod
+
+        src = np.ascontiguousarray(src, np.int32)[start:]
+        dst = np.ascontiguousarray(dst, np.int32)[start:]
+        width = wire_mod.width_for_capacity(capacity)
+        n_full = len(src) // batch
+        outstanding = 0
+        # a refusal mid-pipeline must not desync the connection: every
+        # outstanding reply is still read (in order) before the first
+        # refusal is raised, so the next verb on this socket reads ITS
+        # reply, not a stale push ack
+        refusal: Optional[ServerRefused] = None
+
+        def read_reply():
+            nonlocal refusal
+            reply = protocol.read_frame(self._f)
+            if reply is None:
+                raise ClientError("server closed the connection")
+            head, _pay = reply
+            if not head.get("ok") and refusal is None:
+                refusal = ServerRefused(
+                    head.get("code", "error"), head.get("error", "refused")
+                )
+
+        try:
+            for i in range(n_full):
+                s_b = src[i * batch : (i + 1) * batch]
+                d_b = dst[i * batch : (i + 1) * batch]
+                if bdv:
+                    head = {"verb": "push", "job": job, "kind": "bdv"}
+                    buf = wire_mod.pack_edges_bdv(s_b, d_b, capacity)
+                else:
+                    head = {"verb": "push", "job": job, "kind": "wire"}
+                    buf = wire_mod.pack_edges(s_b, d_b, width)
+                head["token"] = self.token
+                protocol.write_frame(self._f, head, np.ascontiguousarray(buf))
+                outstanding += 1
+                if outstanding >= max(1, window):
+                    read_reply()
+                    outstanding -= 1
+                if refusal is not None:
+                    break  # stop producing; drain what's in flight below
+            while outstanding:
+                read_reply()
+                outstanding -= 1
+        except (OSError, protocol.ProtocolError) as e:
+            raise ClientError(f"transport failure: {e}") from e
+        if refusal is not None:
+            raise refusal
+        if len(src) % batch:
+            self.push_tail(job, src[n_full * batch :], dst[n_full * batch :])
+        if close:
+            self.eos(job)
+        return len(src)
+
+    def results(
+        self, job: str, max_records: int = 256, timeout_ms: int = 1000
+    ) -> Tuple[List[List[np.ndarray]], str, bool]:
+        """Fetch buffered emission records: (records, job state, eos).
+        Each record is the list of its flattened host array leaves."""
+        head, payload = self.call(
+            {
+                "verb": "results",
+                "job": job,
+                "max": max_records,
+                "timeout_ms": timeout_ms,
+            }
+        )
+        records: List[List[np.ndarray]] = []
+        if head["count"]:
+            with np.load(_io.BytesIO(payload)) as data:
+                for i, n_leaves in enumerate(head["leaves"]):
+                    records.append(
+                        [data[f"r{i}_{j}"] for j in range(n_leaves)]
+                    )
+        return records, head["state"], bool(head["eos"])
+
+    def iter_results(
+        self, job: str, poll_timeout_ms: int = 1000, deadline_s: float = 300.0
+    ) -> Iterator[List[np.ndarray]]:
+        """Yield records until end-of-stream (or ``deadline_s``, then
+        ``ClientError`` — a remote hang must fail loudly, not forever)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            records, state, eos = self.results(
+                job, timeout_ms=poll_timeout_ms
+            )
+            for rec in records:
+                yield rec
+            if eos:
+                return
+            if time.monotonic() > deadline:
+                raise ClientError(
+                    f"job {job!r} produced no end-of-stream within "
+                    f"{deadline_s}s (state {state})"
+                )
+
+    def status(self) -> dict:
+        return self.call({"verb": "status"})[0]
+
+    def pause(self, job: str) -> dict:
+        return self.call({"verb": "pause", "job": job})[0]
+
+    def resume(self, job: str) -> dict:
+        return self.call({"verb": "resume", "job": job})[0]
+
+    def cancel(self, job: str) -> dict:
+        return self.call({"verb": "cancel", "job": job})[0]
+
+    def drain(
+        self, jobs: Optional[List[str]] = None, shutdown: bool = False
+    ) -> dict:
+        """Graceful drain; the reply's ``cursors`` map job -> resume
+        cursor (``resume_edges``) for checkpointed push jobs."""
+        header = {"verb": "drain", "shutdown": bool(shutdown)}
+        if jobs is not None:
+            header["jobs"] = list(jobs)
+        return self.call(header)[0]
+
+    def shutdown_server(self) -> dict:
+        return self.call({"verb": "shutdown"})[0]
+
+
+# ---------------------------------------------------------------------------
+# console script
+# ---------------------------------------------------------------------------
+
+
+def _parse_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise SystemExit(f"--connect needs host:port, got {addr!r}")
+    return host, int(port)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gelly-client",
+        description="remote console for a gelly-serve --listen server",
+    )
+    parser.add_argument(
+        "--connect", required=True, help="server address, host:port"
+    )
+    parser.add_argument("--token", default="", help="tenant auth token")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="server + per-job status lines")
+
+    p_submit = sub.add_parser("submit", help="submit a push-source job")
+    p_submit.add_argument("--name", required=True)
+    p_submit.add_argument(
+        "--query", default="cc", choices=("cc", "degree", "edges")
+    )
+    p_submit.add_argument("--capacity", type=int, default=1 << 16)
+    p_submit.add_argument("--window-edges", type=int, default=1 << 13)
+    p_submit.add_argument("--batch", type=int, default=1 << 12)
+    p_submit.add_argument("--weight", type=int, default=1)
+    p_submit.add_argument("--checkpoint", action="store_true")
+
+    p_push = sub.add_parser(
+        "push-edges",
+        help="push a seeded synthetic edge stream into a submitted job "
+        "(geometry flags must match the submit)",
+    )
+    p_push.add_argument("--job", required=True)
+    p_push.add_argument("--edges", type=int, default=100_000)
+    p_push.add_argument("--seed", type=int, default=0)
+    p_push.add_argument("--capacity", type=int, default=1 << 16)
+    p_push.add_argument("--batch", type=int, default=1 << 12)
+    p_push.add_argument("--bdv", action="store_true")
+    p_push.add_argument(
+        "--start", type=int, default=0, help="resume cursor (edges to skip)"
+    )
+    p_push.add_argument(
+        "--no-results",
+        action="store_true",
+        help="push + eos only; don't consume emissions",
+    )
+
+    p_results = sub.add_parser("results", help="stream a job's emissions")
+    p_results.add_argument("--job", required=True)
+
+    p_cancel = sub.add_parser("cancel", help="cancel a job")
+    p_cancel.add_argument("--job", required=True)
+
+    p_drain = sub.add_parser(
+        "drain", help="drain this tenant's jobs; print resume cursors"
+    )
+    p_drain.add_argument("--shutdown", action="store_true")
+
+    args = parser.parse_args(argv)
+    host, port = _parse_addr(args.connect)
+    with GellyClient(host, port, token=args.token) as client:
+        try:
+            return _run_cmd(client, args)
+        except ServerRefused as e:
+            print(f"refused [{e.code}]: {e}", file=sys.stderr)
+            return 2
+
+
+def _run_cmd(client: GellyClient, args) -> int:
+    if args.cmd == "status":
+        reply = client.status()
+        for line in reply["lines"]:
+            print(line)
+        srv = reply["server"]
+        print(
+            f"server: {srv['connections']} connection(s), "
+            f"{srv['served_jobs']} served job(s)"
+        )
+        return 0
+    if args.cmd == "submit":
+        reply = client.submit(
+            name=args.name,
+            query=args.query,
+            capacity=args.capacity,
+            window_edges=args.window_edges,
+            batch=args.batch,
+            weight=args.weight,
+            checkpoint=args.checkpoint,
+        )
+        print(
+            f"submitted {reply['job']}: batch={reply['batch']} "
+            f"window={reply['window_edges']} resume_edges="
+            f"{reply['resume_edges']} accept_bdv={reply['accept_bdv']}"
+        )
+        return 0
+    if args.cmd == "push-edges":
+        rng = np.random.default_rng(args.seed)
+        src = rng.integers(0, args.capacity, args.edges).astype(np.int32)
+        dst = rng.integers(0, args.capacity, args.edges).astype(np.int32)
+        t0 = time.perf_counter()
+        pushed = client.push_edges(
+            args.job,
+            src,
+            dst,
+            batch=args.batch,
+            capacity=args.capacity,
+            bdv=args.bdv,
+            start=args.start,
+        )
+        dt = time.perf_counter() - t0
+        print(
+            f"pushed {pushed} edges in {dt:.2f}s "
+            f"({pushed / max(dt, 1e-9):.0f} eps over the socket)"
+        )
+        if not args.no_results:
+            n = 0
+            for _rec in client.iter_results(args.job):
+                n += 1
+            print(f"{n} record(s), end of stream")
+        return 0
+    if args.cmd == "results":
+        n = 0
+        for rec in client.iter_results(args.job):
+            n += 1
+            shapes = ", ".join(str(leaf.shape) for leaf in rec)
+            print(f"record {n}: {len(rec)} leaves [{shapes}]")
+        print(f"{n} record(s), end of stream")
+        return 0
+    if args.cmd == "cancel":
+        reply = client.cancel(args.job)
+        print(f"cancel {args.job}: state={reply['state']}")
+        return 0
+    if args.cmd == "drain":
+        reply = client.drain(shutdown=args.shutdown)
+        for name, cur in sorted(reply["cursors"].items()):
+            print(
+                f"{name}: state={cur['state']} resume_edges="
+                f"{cur['resume_edges']} pending={cur['records_pending']}"
+            )
+        return 0
+    raise SystemExit(f"unknown command {args.cmd!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,7 @@ class Job:
         edges_per_record: int = 0,
         edges_hint: Optional[int] = None,
         queue_depth: int = 64,
+        ready: Optional[Callable[[], bool]] = None,
     ):
         if weight <= 0:
             raise ValueError("job weight must be positive")
@@ -136,6 +137,12 @@ class Job:
         # the same checkpoint path restores position through the merge
         # loop's own machinery, nothing runtime-specific
         self._build = build
+        # source-readiness gate for jobs fed by an external producer (the
+        # network ingest plane): the scheduler calls it before pulling and
+        # SKIPS the job's round on False, so a pull never blocks the shared
+        # scheduler thread on a slow or dead producer.  Must be thread-safe
+        # and non-blocking; None = always runnable (the historical default).
+        self._ready = ready
         self._lock = manager_lock  # the MANAGER's lock, shared by reference
         self._state = JobState.PENDING  # guarded-by: _lock
         self._error: Optional[BaseException] = None  # guarded-by: _lock

@@ -80,6 +80,7 @@ class JobManager:
         state_bytes: int = 0,
         edges_per_record: int = 0,
         edges_hint: Optional[int] = None,
+        ready: Optional[Callable[[], bool]] = None,
     ) -> Job:
         """Admit a query whose ``build()`` returns a fresh records iterator
         (the ``OutputStream`` contract: ``iter(stream.aggregate(...))``).
@@ -89,6 +90,13 @@ class JobManager:
         via ``SummaryAggregation.state_nbytes``; ``submit_aggregation``
         fills it in).  Raises ``AdmissionError`` when either cap would be
         exceeded — the job is NOT enqueued.
+
+        ``ready`` (externally-fed sources, e.g. the network ingest plane's
+        ``NetworkEdgeSource.ready``): a thread-safe, non-blocking callable
+        the scheduler consults before pulling; False skips the job for the
+        round (counted as ``job_source_wait_skips``) so a starved source
+        idles its own job, never the scheduler.  Producers should ``poke()``
+        the manager after feeding the source.
         """
         state_bytes = int(state_bytes)
         with self._lock:
@@ -143,6 +151,7 @@ class JobManager:
                 edges_per_record=edges_per_record,
                 edges_hint=edges_hint,
                 queue_depth=self.cfg.job_queue_depth,
+                ready=ready,
             )
             job._manager = self
             self._jobs[job_id] = job
@@ -286,6 +295,13 @@ class JobManager:
             "admitted_state_bytes": admitted,
             "totals": metrics.job_totals(),
         }
+
+    def poke(self) -> None:
+        """Wake the scheduler to re-check job readiness — producers feeding
+        an externally-driven source (``submit(ready=...)``) call this after
+        queueing data so the next round starts now rather than at the
+        parked loop's 50 ms re-check."""
+        self._wake.set()
 
     def wait_all(self, timeout: Optional[float] = None) -> bool:
         """Block until every submitted job is terminal (True) or the
@@ -449,6 +465,19 @@ class JobManager:
         if cancel_now:
             self._cancel_now(job)
             return True
+        ready = job._ready
+        if ready is not None:
+            # the network-source gate: a pull would block the ONE scheduler
+            # thread on that job's producer, so an un-ready source skips the
+            # round instead (cancel above still wins: a dead client's job
+            # stays cancellable forever)
+            try:
+                if not ready():
+                    metrics.job_add(job.job_id, "job_source_wait_skips", 1)
+                    return False
+            except BaseException as e:
+                self._fail(job, e)
+                return True
         credits = job.weight * self.cfg.fair_quantum
         pulled = 0
         for _ in range(credits):
@@ -458,6 +487,13 @@ class JobManager:
                 break
             if job._out.full():
                 metrics.job_add(job.job_id, "job_queue_full_skips", 1)
+                break
+            if pulled and ready is not None and not ready():
+                # re-check between pulls: each pull drains a window's worth
+                # from the source, so readiness established for the FIRST
+                # pull says nothing about the rest of the quantum — a pull
+                # past the queued data would block the scheduler thread on
+                # that job's producer (the wedge the gate exists to prevent)
                 break
             if job._it is None:
                 build = job._build

@@ -1,0 +1,151 @@
+"""Frame protocol for the streaming RPC serving plane (ISSUE 8).
+
+One frame = a small JSON header plus an optional raw binary payload,
+length-prefixed so edge batches cross the socket as the SAME uint8 wire
+buffers the device pipeline consumes (io/wire.py fixed-width or BDV — the
+~2.7 B/edge encoding from the propagation-blocking PR applied to the
+network link), never re-encoded through a text codec.  Pure stdlib by
+construction: no msgpack, no protobuf, nothing the container doesn't have.
+
+Frame grammar (all integers big-endian)::
+
+    frame   := magic(4) header_len(u32) payload_len(u32) header payload
+    magic   := b"GLY1"                    # protocol id + version
+    header  := UTF-8 JSON object, header_len bytes
+    payload := payload_len raw bytes (may be empty)
+
+Requests carry ``{"verb": ..., "token": ..., ...}``; replies carry
+``{"ok": true/false, ...}`` with ``error`` and ``code`` on refusals.
+
+Robustness is by construction, not by handler discipline: the reader
+refuses bad magic, oversized headers/payloads, truncated streams, and
+non-object/undecodable headers with TYPED exceptions (``BadFrame`` /
+``FrameTooLarge`` / clean-EOF ``None``), so the server can always answer
+with a clean error frame instead of a hang or a traceback-closed socket —
+pinned by tests/test_server.py's garbage/truncation/oversize cases.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional, Tuple
+
+MAGIC = b"GLY1"
+
+# a header is routing metadata, not data: anything bigger is garbage (or an
+# attempt to smuggle the payload into the JSON channel)
+MAX_HEADER_BYTES = 1 << 16
+
+# default payload ceiling for readers that don't get a configured one
+# (clients); servers pass ServerConfig.max_frame_bytes
+DEFAULT_MAX_PAYLOAD = 1 << 26
+
+_PREFIX = struct.Struct(">4sII")
+
+
+class ProtocolError(Exception):
+    """Base class for frame-layer failures."""
+
+
+class BadFrame(ProtocolError):
+    """Garbage, truncated, or undecodable frame: the stream cannot be
+    resynchronized — reply with an error frame (best effort) and close."""
+
+
+class FrameTooLarge(ProtocolError):
+    """Declared header/payload length exceeds the configured cap.  The
+    oversized bytes are UNREAD (reading them is the attack), so the
+    connection must be closed after the error reply."""
+
+
+def write_frame(fileobj, header: dict, payload: bytes = b"") -> None:
+    """Serialize one frame onto a buffered binary file object and flush.
+
+    ``payload`` accepts any bytes-like object (memoryview/ndarray buffers
+    included) — it is written as-is, no copy through the JSON layer.
+    """
+    head = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    if len(head) > MAX_HEADER_BYTES:
+        raise FrameTooLarge(
+            f"header of {len(head)} bytes exceeds {MAX_HEADER_BYTES}"
+        )
+    # no truthiness test: bool(ndarray) raises for multi-element arrays,
+    # and the cast alone handles empty payloads fine (buffers must be
+    # C-contiguous — callers own the layout)
+    payload = memoryview(payload if payload is not None else b"").cast("B")
+    fileobj.write(_PREFIX.pack(MAGIC, len(head), len(payload)))
+    fileobj.write(head)
+    if len(payload):
+        fileobj.write(payload)
+    fileobj.flush()
+
+
+def _read_exact(fileobj, n: int, what: str) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None on clean EOF at offset 0 of ``what``
+    (only meaningful at a frame boundary), BadFrame on EOF mid-read."""
+    if n == 0:
+        return b""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = fileobj.read(n - got)
+        if not chunk:
+            if got == 0:
+                return None
+            raise BadFrame(
+                f"connection closed mid-frame: {got}/{n} bytes of {what}"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(
+    fileobj, max_payload: int = DEFAULT_MAX_PAYLOAD
+) -> Optional[Tuple[dict, bytes]]:
+    """Read one frame; ``None`` on clean EOF at a frame boundary.
+
+    Raises ``BadFrame`` for garbage/truncation and ``FrameTooLarge`` when a
+    declared length exceeds the caps — in both cases WITHOUT consuming the
+    refused payload bytes, so the caller's only safe continuation is an
+    error frame + close (documented in the class docstrings).
+    """
+    prefix = _read_exact(fileobj, _PREFIX.size, "frame prefix")
+    if prefix is None:
+        return None
+    magic, header_len, payload_len = _PREFIX.unpack(prefix)
+    if magic != MAGIC:
+        raise BadFrame(f"bad frame magic {magic!r} (expected {MAGIC!r})")
+    if header_len > MAX_HEADER_BYTES:
+        raise FrameTooLarge(
+            f"declared header of {header_len} bytes exceeds "
+            f"{MAX_HEADER_BYTES}"
+        )
+    if payload_len > max_payload:
+        raise FrameTooLarge(
+            f"declared payload of {payload_len} bytes exceeds the "
+            f"{max_payload}-byte frame cap"
+        )
+    head_bytes = _read_exact(fileobj, header_len, "frame header")
+    if head_bytes is None:
+        raise BadFrame("connection closed before the frame header")
+    try:
+        header = json.loads(head_bytes.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise BadFrame(f"undecodable frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise BadFrame(
+            f"frame header must be a JSON object, got {type(header).__name__}"
+        )
+    payload = _read_exact(fileobj, payload_len, "frame payload")
+    if payload is None:
+        raise BadFrame("connection closed before the frame payload")
+    return header, payload
+
+
+def error_reply(message: str, code: str = "error", **extra) -> dict:
+    """The one refusal shape every handler uses (clients match on it)."""
+    out = {"ok": False, "error": str(message), "code": code}
+    out.update(extra)
+    return out

@@ -34,49 +34,24 @@ import time
 
 import numpy as np
 
-from gelly_streaming_tpu.core.config import RuntimeConfig, StreamConfig
+from gelly_streaming_tpu.core.config import (
+    RuntimeConfig,
+    ServerConfig,
+    StreamConfig,
+    TenantConfig,
+)
 from gelly_streaming_tpu.runtime.manager import JobManager
 
 
-# the "edges" query's descriptor class, created ONCE per process: its
-# cache_token is the class, so every edge-count job shares one set of
-# compiled executables (a fresh class per job would recompile per job —
-# exactly the N-compilations cost the runtime exists to avoid)
-_EDGE_COUNT_CLS = None
-
-
-def _edge_count_descriptor():
-    global _EDGE_COUNT_CLS
-    if _EDGE_COUNT_CLS is None:
-        import jax.numpy as jnp
-
-        from gelly_streaming_tpu.core.aggregation import (
-            SummaryBulkAggregation,
-        )
-
-        class EdgeCount(SummaryBulkAggregation):
-            order_free = True
-
-            @property
-            def cache_token(self):
-                return type(self)
-
-            def initial_state(self, cfg):
-                return jnp.zeros((), jnp.int32)
-
-            def update(self, state, src, dst, val, mask):
-                return state + jnp.sum(mask.astype(jnp.int32))
-
-            def combine(self, a, b):
-                return a + b
-
-        _EDGE_COUNT_CLS = EdgeCount
-    return _EDGE_COUNT_CLS()
-
-
 def _build_query(spec: dict):
-    """(stream, descriptor) for one job spec (imports deferred: jax-heavy)."""
+    """(stream, descriptor) for one job spec (imports deferred: jax-heavy).
+
+    The query catalog itself lives in runtime/server.py
+    (``descriptor_for``) — ONE switch serves both the local synthetic
+    driver and the serving plane's remote submits.
+    """
     from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.runtime import server as server_mod
 
     query = spec.get("query", "cc")
     n = int(spec.get("edges", 100_000))
@@ -97,27 +72,18 @@ def _build_query(spec: dict):
         ingest_window_edges=window_edges,
     )
     stream = EdgeStream.from_arrays(src, dst, cfg)
-
-    if query == "cc":
-        from gelly_streaming_tpu.library.connected_components import (
-            ConnectedComponents,
-        )
-
-        return stream, ConnectedComponents()
-    if query == "degree":
-        from gelly_streaming_tpu.library.degree_distribution import (
-            DegreeDistributionSummary,
-        )
-
-        return stream, DegreeDistributionSummary()
-    if query == "edges":
-        return stream, _edge_count_descriptor()
-    raise SystemExit(f"unknown query {query!r} (expected cc/degree/edges)")
+    try:
+        return stream, server_mod.descriptor_for(query)
+    except server_mod._Refused as e:
+        raise SystemExit(str(e))
 
 
-def _status_lines(manager: JobManager) -> list:
+def _status_lines(status: dict) -> list:
+    """Render one console line per job from a ``JobManager.status()``
+    mapping.  Takes the STATUS DICT (not the manager) so the server's
+    ``status`` verb reuses the exact same renderer over the wire — the
+    remote console and the local driver cannot drift apart."""
     lines = []
-    status = manager.status()
     for job_id in sorted(status["jobs"]):
         s = status["jobs"][job_id]
         lines.append(
@@ -143,6 +109,21 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--config", help="JSON job config (see module doc)")
     parser.add_argument(
+        "--listen",
+        metavar="HOST:PORT",
+        help="start the streaming RPC serving plane on this address "
+        "(runtime/server.py) instead of exiting when the config jobs "
+        "finish; PORT 0 binds an ephemeral port (printed on stderr). "
+        "Remote clients (gelly-client / GellyClient) can then submit "
+        "jobs, push edge batches, and drain.",
+    )
+    parser.add_argument(
+        "--checkpoint-prefix",
+        help="per-(tenant, job) snapshot prefix for remote jobs submitted "
+        "with checkpoint: true (defaults to the config's "
+        "checkpoint_prefix)",
+    )
+    parser.add_argument(
         "--jobs", type=int, default=2, help="synthetic same-shape job count"
     )
     parser.add_argument(
@@ -165,6 +146,9 @@ def main(argv=None) -> int:
     if args.config:
         with open(args.config) as f:
             conf = json.load(f)
+    elif args.listen:
+        # a bare listener starts EMPTY: remote clients submit the jobs
+        conf = {"jobs": []}
     else:
         conf = {
             "jobs": [
@@ -180,7 +164,7 @@ def main(argv=None) -> int:
             ]
         }
     specs = conf.get("jobs") or []
-    if not specs:
+    if not specs and not args.listen:
         print("no jobs in config", file=sys.stderr)
         return 2
 
@@ -202,6 +186,9 @@ def main(argv=None) -> int:
     # shared-prefix model, utils.checkpoint.per_job_file)
     prefix = conf.get("checkpoint_prefix")
 
+    if args.listen:
+        return _serve_listen(args, conf, specs, rt_cfg, sink, prefix)
+
     t0 = time.perf_counter()
     with JobManager(rt_cfg) as manager:
         for spec in specs:
@@ -222,12 +209,12 @@ def main(argv=None) -> int:
             )
         while not manager.wait_all(timeout=args.status_interval or 0.25):
             if args.status_interval:
-                for line in _status_lines(manager):
+                for line in _status_lines(manager.status()):
                     print(line, file=sys.stderr)
                 print("---", file=sys.stderr)
         elapsed = time.perf_counter() - t0
         print("final:", file=sys.stderr)
-        for line in _status_lines(manager):
+        for line in _status_lines(manager.status()):
             print(line, file=sys.stderr)
         status = manager.status()
         failed = [
@@ -242,6 +229,60 @@ def main(argv=None) -> int:
             f"({totals['job_edges'] / max(elapsed, 1e-9):.0f} eps aggregate)"
         )
     return 1 if failed else 0
+
+
+def _serve_listen(args, conf, specs, rt_cfg, sink, prefix) -> int:
+    """``--listen`` mode: the long-lived serving plane.  Config jobs (if
+    any) run as local jobs alongside remote submissions; the process stays
+    up until a client's ``shutdown`` (or ``drain --shutdown``) verb."""
+    from gelly_streaming_tpu.runtime.server import StreamServer
+
+    host, _, port_s = args.listen.rpartition(":")
+    if not host or not port_s.isdigit():
+        print(f"--listen needs HOST:PORT, got {args.listen!r}", file=sys.stderr)
+        return 2
+    tenants = tuple(
+        TenantConfig(
+            tenant=t["tenant"],
+            token=t["token"],
+            max_jobs=int(t.get("max_jobs", 0)),
+            max_state_bytes=int(t.get("max_state_bytes", 0)),
+            max_ingest_bps=int(t.get("max_ingest_bps", 0)),
+            weight=int(t.get("weight", 1)),
+        )
+        for t in conf.get("tenants", [])
+    )
+    srv_cfg = ServerConfig(
+        host=host,
+        port=int(port_s),
+        tenants=tenants,
+        checkpoint_prefix=args.checkpoint_prefix or prefix,
+    )
+    with JobManager(rt_cfg) as manager:
+        with StreamServer(manager, srv_cfg) as server:
+            # machine-readable so drivers/tests can find an ephemeral port
+            print(
+                f"gelly-serve: listening on {srv_cfg.host}:{server.port}",
+                file=sys.stderr,
+                flush=True,
+            )
+            for spec in specs:
+                stream, descriptor = _build_query(spec)
+                name = spec.get("name") or f"{spec.get('query', 'cc')}-job"
+                manager.submit_aggregation(
+                    stream,
+                    descriptor,
+                    name=name,
+                    sink=sink,
+                    weight=int(spec.get("weight", 1)),
+                )
+            while not server.wait_shutdown(args.status_interval or 5.0):
+                if args.status_interval:
+                    for line in _status_lines(manager.status()):
+                        print(line, file=sys.stderr)
+                    print("---", file=sys.stderr)
+            print("gelly-serve: shutdown requested", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

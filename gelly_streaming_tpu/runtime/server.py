@@ -1,0 +1,1043 @@
+"""Streaming RPC serving plane: a long-lived network frontend on the
+multi-tenant job runtime (ISSUE 8).
+
+Everything below PR 5's ``JobManager`` spoke an in-process API driven by a
+local config; this module is the layer the runtime was built to carry
+(ROADMAP open item 1): a socket server exposing the job lifecycle —
+``submit`` / ``pause`` / ``resume`` / ``cancel`` / ``status`` / ``drain``
+— plus NETWORK EDGE INGESTION: clients push the framework's own wire
+buffers (fixed-width or BDV-compressed, ~2.7 B/edge on the socket) into a
+running job's ``NetworkEdgeSource`` (io/sources.py), and consume emission
+records back with ``results``.
+
+Architecture (pure stdlib: socket + selectors + threading):
+
+* an ACCEPT loop (selectors over the listener) spawns one handler thread
+  per connection, bounded by ``ServerConfig.max_connections``;
+* each connection speaks length-prefixed JSON+binary frames
+  (runtime/protocol.py); malformed/oversized frames get a clean error
+  frame — never a hang, never a traceback-closed socket;
+* per-tenant AUTH (token per request), QUOTAS (jobs, state bytes, ingest
+  bytes/s via a token bucket that throttles the pushing connection), and
+  PRIORITY (tenant weight multiplies job weight in the weighted-fair
+  scheduler) layer onto the existing admission control;
+* isolation is the same story at every layer: a slow/dead client
+  backpressures its own socket (bounded ingest queue) and idles its own
+  job (``NetworkEdgeSource.ready`` gating the scheduler round), while a
+  slow results consumer blocks its own job's sink pump — the scheduler
+  round and other tenants never wait;
+* DRAIN rides the per-job positional checkpoints: quiesce the sources,
+  flush in-flight windows through the normal completion-queue cancel path,
+  and reply with resume cursors — a restarted server + reconnecting client
+  resumes bit-exactly from the cursor (the replay-skip contract every
+  checkpointed plane already pins).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from gelly_streaming_tpu.core.config import (
+    ServerConfig,
+    StreamConfig,
+    TenantConfig,
+)
+from gelly_streaming_tpu.runtime import protocol
+from gelly_streaming_tpu.runtime.job import AdmissionError, Job, JobState
+from gelly_streaming_tpu.runtime.manager import JobManager
+from gelly_streaming_tpu.utils import metrics
+
+
+# server-side synthetic streams ("generate" submits) materialize host
+# arrays outside the summary-state admission pricing; 2^24 edges (~128 MB
+# of int32 columns) bounds what one remote spec can allocate
+MAX_GENERATE_EDGES = 1 << 24
+
+
+class _Refused(Exception):
+    """A request the server declines with a typed error reply (the
+    connection stays open — the frame itself was well-formed)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+# the "edges" query's descriptor class, created ONCE per process: its
+# cache_token is the class, so every edge-count job shares one set of
+# compiled executables (a fresh class per job would recompile per job —
+# exactly the N-compilations cost the runtime exists to avoid)
+_EDGE_COUNT_CLS = None
+
+
+def _edge_count_descriptor():
+    global _EDGE_COUNT_CLS
+    if _EDGE_COUNT_CLS is None:
+        import jax.numpy as jnp
+
+        from gelly_streaming_tpu.core.aggregation import (
+            SummaryBulkAggregation,
+        )
+
+        class EdgeCount(SummaryBulkAggregation):
+            order_free = True
+
+            @property
+            def cache_token(self):
+                return type(self)
+
+            def initial_state(self, cfg):
+                return jnp.zeros((), jnp.int32)
+
+            def update(self, state, src, dst, val, mask):
+                return state + jnp.sum(mask.astype(jnp.int32))
+
+            def combine(self, a, b):
+                return a + b
+
+        _EDGE_COUNT_CLS = EdgeCount
+    return _EDGE_COUNT_CLS()
+
+
+def descriptor_for(query: str):
+    """The serving plane's query catalog (shared with ``gelly-serve``'s
+    synthetic driver): ``cc`` / ``degree`` / ``edges``."""
+    if query == "cc":
+        from gelly_streaming_tpu.library.connected_components import (
+            ConnectedComponents,
+        )
+
+        return ConnectedComponents()
+    if query == "degree":
+        from gelly_streaming_tpu.library.degree_distribution import (
+            DegreeDistributionSummary,
+        )
+
+        return DegreeDistributionSummary()
+    if query == "edges":
+        return _edge_count_descriptor()
+    raise _Refused(
+        "bad-spec", f"unknown query {query!r} (expected cc/degree/edges)"
+    )
+
+
+def record_leaves(rec) -> list:
+    """Flatten one emission record to its host array leaves — the wire
+    representation of a record (``results`` replies ship exactly these).
+
+    Deterministic walk: tuples/lists in order, dicts and object
+    ``__dict__``s by sorted key — so a remote consumer sees the SAME leaf
+    sequence an in-process consumer flattening the same record would (the
+    bit-identity contract tests/test_server.py pins).  Summary objects
+    (e.g. connected components' ``DisjointSet``) are plain host wrappers,
+    not registered pytrees, so ``jax.tree.leaves`` alone would return them
+    opaque — their array attributes are what travels.  Anything that would
+    land as a pickled object array is refused loudly instead (the wire
+    carries arrays, never code).
+    """
+    import jax
+
+    out: list = []
+
+    def walk(x):
+        if isinstance(x, (tuple, list)):
+            for item in x:
+                walk(item)
+            return
+        if isinstance(x, dict):
+            for key in sorted(x):
+                walk(x[key])
+            return
+        if isinstance(x, (np.ndarray, np.generic, int, float, bool, jax.Array)):
+            out.append(np.asarray(x))
+            return
+        state = getattr(x, "__dict__", None)
+        if state:
+            for key in sorted(state):
+                walk(state[key])
+            return
+        arr = np.asarray(x)
+        if arr.dtype == object:
+            raise TypeError(
+                f"record leaf of type {type(x).__name__} has no array "
+                "representation; the results wire format carries arrays only"
+            )
+        out.append(arr)
+
+    walk(rec)
+    return out
+
+
+class _TokenBucket:
+    """Per-tenant ingest rate limiter (bytes/second, 1-second burst).
+
+    ``reserve`` COMPUTES the debt-sleep under the lock and returns it; the
+    caller sleeps outside — so one throttled connection never holds the
+    bucket against the tenant's other connections.
+    """
+
+    def __init__(self, bps: int):
+        self.bps = int(bps)
+        self._lock = threading.Lock()
+        self._avail = float(max(self.bps, 1))  # guarded-by: _lock
+        self._last = time.monotonic()  # guarded-by: _lock
+
+    def reserve(self, nbytes: int) -> float:
+        """Charge ``nbytes``; returns seconds the caller must sleep (0 when
+        under the rate).  Debt-based: the charge always succeeds, the sleep
+        repays it, so a single frame larger than one second's budget is
+        throttled proportionally instead of deadlocking."""
+        if not self.bps:
+            return 0.0
+        with self._lock:
+            now = time.monotonic()
+            burst = float(max(self.bps, 1))
+            self._avail = min(burst, self._avail + (now - self._last) * self.bps)
+            self._last = now
+            self._avail -= float(nbytes)
+            if self._avail >= 0:
+                return 0.0
+            return -self._avail / self.bps
+
+
+class _ServedJob:
+    """Server-side bookkeeping for one submitted job: the network source
+    (push jobs), the spec it was built from, and the bounded emission
+    buffer its sink fills for ``results`` fetches."""
+
+    def __init__(
+        self,
+        name: str,
+        tenant: str,
+        cfg: StreamConfig,
+        descriptor,
+        source,
+        checkpoint_path: Optional[str],
+        buffer_cap: int,
+    ):
+        self.name = name
+        self.tenant = tenant
+        self.cfg = cfg
+        self.descriptor = descriptor
+        self.source = source  # None for server-generated sources
+        self.checkpoint_path = checkpoint_path
+        self.job: Optional[Job] = None  # set right after manager.submit
+        self.accept_bdv = False
+        self._cap = max(1, buffer_cap)
+        self._cond = threading.Condition()
+        # emission records (host leaf-array lists) awaiting a results fetch
+        self._records: deque = deque()  # guarded-by: _cond
+        self._abandoned = False  # guarded-by: _cond
+
+    def sink(self, rec) -> None:
+        """The job's sink (runs on its per-job sink-pump thread):
+        materialize the record's leaves to host and buffer them.  A full
+        buffer blocks HERE — the pump stalls, the job's bounded emission
+        queue fills, and the scheduler skips that one job's rounds: the
+        slow-consumer isolation boundary, end to end."""
+        leaves = record_leaves(rec)
+        with self._cond:
+            while len(self._records) >= self._cap and not self._abandoned:
+                self._cond.wait(0.1)
+            if self._abandoned:
+                return
+            self._records.append(leaves)
+            self._cond.notify_all()
+
+    def fetch(self, max_records: int, timeout_s: float, max_bytes: int):
+        """Up to ``max_records`` / ``max_bytes`` of buffered records
+        (blocking up to ``timeout_s`` for the first), plus (state, eos).
+
+        The BYTE bound is the real contract: records are popped
+        destructively and the reply must fit the client's frame cap — an
+        unbounded reply would be refused by the reader and lose the popped
+        records with no redelivery.  At least one record always ships
+        (a single record is bounded by the summary's own state size, well
+        under any sane frame cap).
+        """
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        out = []
+        nbytes = 0
+        while True:
+            with self._cond:
+                while (
+                    self._records
+                    and len(out) < max_records
+                    and nbytes < max_bytes
+                ):
+                    leaves = self._records.popleft()
+                    out.append(leaves)
+                    nbytes += sum(leaf.nbytes for leaf in leaves)
+                self._cond.notify_all()  # sink may be waiting on space
+                have_more = bool(self._records)
+            state = self.job.state if self.job is not None else "PENDING"
+            pump = self.job._sink_thread if self.job is not None else None
+            pump_done = pump is not None and not pump.is_alive()
+            eos = (
+                state in JobState.TERMINAL
+                and pump_done
+                and not have_more
+                and not out
+            )
+            if out or eos:
+                return out, state, eos
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return out, state, False
+            with self._cond:
+                self._cond.wait(min(0.1, left))
+
+    def pending_records(self) -> int:
+        with self._cond:
+            return len(self._records)
+
+    def abandon(self) -> None:
+        """Server shutdown: release a sink blocked on buffer space."""
+        with self._cond:
+            self._abandoned = True
+            self._cond.notify_all()
+
+
+class StreamServer:
+    """The long-lived network frontend over one ``JobManager``.
+
+    Use as a context manager::
+
+        with JobManager(rt_cfg) as jm, StreamServer(jm, srv_cfg) as server:
+            ...  # server.port is bound; clients connect
+    """
+
+    _VERBS = (
+        "ping",
+        "submit",
+        "push",
+        "eos",
+        "results",
+        "status",
+        "pause",
+        "resume",
+        "cancel",
+        "drain",
+        "shutdown",
+    )
+
+    def __init__(self, manager: JobManager, cfg: ServerConfig = ServerConfig()):
+        self.manager = manager
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._conns: set = set()  # guarded-by: _lock
+        self._jobs: Dict[str, _ServedJob] = {}  # guarded-by: _lock
+        # serializes tenant-cap check -> manager.submit -> registration:
+        # two concurrent submits must not both pass a tenant's job/byte cap
+        # before either registers (the check-then-act race the corpus pair
+        # pins for the connection registry, applied to admission)
+        self._admission = threading.Lock()
+        self._stop = threading.Event()
+        self._shutdown_requested = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._port: Optional[int] = None
+        # open mode: zero configured tenants = one implicit open tenant
+        self._open_mode = not cfg.tenants
+        self._by_token = {t.token: t for t in cfg.tenants}
+        self._open_tenant = TenantConfig()
+        self._buckets = {
+            t.tenant: _TokenBucket(t.max_ingest_bps) for t in cfg.tenants
+        }
+        self._buckets.setdefault(self._open_tenant.tenant, _TokenBucket(0))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._port is None:
+            raise RuntimeError("server not started")
+        return self._port
+
+    def start(self) -> "StreamServer":
+        self._sock = socket.create_server(
+            (self.cfg.host, self.cfg.port), backlog=16, reuse_port=False
+        )
+        self._port = self._sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="gelly-server-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Close the listener and every connection; jobs are the caller's
+        (``manager.shutdown`` / the drain verb decide their fate)."""
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+            served = list(self._jobs.values())
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for sj in served:
+            sj.abandon()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> bool:
+        """Block until a client requested ``shutdown`` (or drain with
+        ``shutdown: true``); the ``gelly-serve --listen`` loop's exit."""
+        return self._shutdown_requested.wait(timeout)
+
+    def __enter__(self) -> "StreamServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- connection plumbing -------------------------------------------------
+
+    def _accept_loop(self) -> None:  # single-thread: acceptor
+        sel = selectors.DefaultSelector()
+        sel.register(self._sock, selectors.EVENT_READ)
+        try:
+            while not self._stop.is_set():
+                if not sel.select(timeout=0.2):
+                    continue
+                try:
+                    sock, _addr = self._sock.accept()
+                except OSError:
+                    return
+                try:
+                    # request/reply framing: Nagle + delayed ACK would add
+                    # ~40 ms to every small frame round trip
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    pass
+                with self._lock:
+                    over = len(self._conns) >= self.cfg.max_connections
+                    if not over:
+                        self._conns.add(sock)
+                if over:
+                    self._refuse_connection(sock)
+                    continue
+                threading.Thread(
+                    target=self._serve_conn,
+                    args=(sock,),
+                    name="gelly-server-conn",
+                    daemon=True,
+                ).start()
+        finally:
+            sel.close()
+
+    def _refuse_connection(self, sock: socket.socket) -> None:
+        try:
+            f = sock.makefile("wb")
+            protocol.write_frame(
+                f,
+                protocol.error_reply(
+                    f"connection limit ({self.cfg.max_connections}) reached",
+                    code="busy",
+                ),
+            )
+            f.close()
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        f = sock.makefile("rwb")
+        try:
+            while not self._stop.is_set():
+                try:
+                    frame = protocol.read_frame(f, self.cfg.max_frame_bytes)
+                except protocol.FrameTooLarge as e:
+                    # the oversized payload is unread: reply, then close
+                    # (the stream cannot be resynced past it)
+                    self._best_effort_reply(
+                        f, protocol.error_reply(str(e), code="frame-too-large")
+                    )
+                    break
+                except protocol.ProtocolError as e:
+                    self._best_effort_reply(
+                        f, protocol.error_reply(str(e), code="bad-frame")
+                    )
+                    break
+                except OSError:
+                    break
+                if frame is None:
+                    break  # clean EOF
+                header, payload = frame
+                reply, pay, close_after = self._dispatch(header, payload)
+                try:
+                    protocol.write_frame(f, reply, pay)
+                except OSError:
+                    break
+                if close_after:
+                    break
+        finally:
+            with self._lock:
+                self._conns.discard(sock)
+            try:
+                f.close()
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _best_effort_reply(f, header: dict) -> None:
+        try:
+            protocol.write_frame(f, header)
+        except OSError:
+            pass
+
+    # -- request dispatch ----------------------------------------------------
+
+    def _tenant_for(self, header: dict) -> TenantConfig:
+        if self._open_mode:
+            return self._open_tenant
+        token = header.get("token")
+        tenant = self._by_token.get(token) if isinstance(token, str) else None
+        if tenant is None:
+            raise _Refused("auth", "unknown or missing tenant token")
+        return tenant
+
+    def _dispatch(
+        self, header: dict, payload: bytes
+    ) -> Tuple[dict, bytes, bool]:
+        verb = header.get("verb")
+        try:
+            tenant = self._tenant_for(header)
+        except _Refused as e:
+            return protocol.error_reply(str(e), code=e.code), b"", False
+        metrics.tenant_add(tenant.tenant, "tenant_requests", 1)
+        if verb not in self._VERBS:
+            return (
+                protocol.error_reply(
+                    f"unknown verb {verb!r} (expected one of "
+                    f"{'/'.join(self._VERBS)})",
+                    code="unknown-verb",
+                ),
+                b"",
+                False,
+            )
+        handler = getattr(self, "_h_" + verb)
+        try:
+            return handler(tenant, header, payload)
+        except _Refused as e:
+            return protocol.error_reply(str(e), code=e.code), b"", False
+        except Exception as e:  # a handler bug must not kill the socket
+            return (
+                protocol.error_reply(
+                    f"{type(e).__name__}: {e}", code="internal"
+                ),
+                b"",
+                False,
+            )
+
+    def _job_key(self, tenant: TenantConfig, name: str) -> str:
+        return f"{tenant.tenant}/{name}"
+
+    def _served(self, tenant: TenantConfig, header: dict) -> _ServedJob:
+        name = header.get("job")
+        if not isinstance(name, str) or not name:
+            raise _Refused("bad-spec", "missing 'job' field")
+        with self._lock:
+            sj = self._jobs.get(self._job_key(tenant, name))
+        if sj is None:
+            raise _Refused(
+                "unknown-job", f"no job {name!r} for tenant {tenant.tenant!r}"
+            )
+        return sj
+
+    # -- verbs ---------------------------------------------------------------
+
+    def _h_ping(self, tenant, header, payload):
+        return {"ok": True, "tenant": tenant.tenant}, b"", False
+
+    def _h_submit(self, tenant, header, payload):
+        spec = header.get("spec")
+        if not isinstance(spec, dict):
+            raise _Refused("bad-spec", "submit needs a 'spec' object")
+        name = spec.get("name")
+        if not isinstance(name, str) or not name:
+            raise _Refused("bad-spec", "job spec needs a non-empty 'name'")
+        key = self._job_key(tenant, name)
+        query = spec.get("query", "cc")
+        weight = int(spec.get("weight", 1))
+        if weight <= 0:
+            raise _Refused("bad-spec", "job weight must be positive")
+
+        checkpoint_path = None
+        if spec.get("checkpoint"):
+            if not self.cfg.checkpoint_prefix:
+                raise _Refused(
+                    "bad-spec",
+                    "server has no checkpoint_prefix configured; "
+                    "checkpointed jobs are unavailable",
+                )
+            from gelly_streaming_tpu.utils.checkpoint import per_job_file
+
+            checkpoint_path = per_job_file(
+                self.cfg.checkpoint_prefix, f"{tenant.tenant}.{name}"
+            )
+
+        source_kind = spec.get("source", "push")
+        if source_kind == "push":
+            try:
+                cfg = StreamConfig(
+                    vertex_capacity=int(spec.get("capacity", 1 << 16)),
+                    batch_size=int(spec.get("batch", 1 << 10)),
+                    ingest_window_edges=int(spec.get("window_edges", 0)),
+                    async_windows=int(spec.get("async_windows", 0)),
+                    num_shards=int(spec.get("num_shards", 1)),
+                )
+            except (TypeError, ValueError) as e:
+                raise _Refused("bad-spec", f"bad stream config: {e}")
+            descriptor = descriptor_for(query)
+            stream = None
+        elif source_kind == "generate":
+            from gelly_streaming_tpu.runtime.serve import _build_query
+
+            # the synthetic stream is materialized host-side OUTSIDE the
+            # summary-state admission caps: a client-controlled edge count
+            # must not be able to OOM the server process
+            n_gen = int(spec.get("edges", 100_000))
+            if n_gen > MAX_GENERATE_EDGES:
+                raise _Refused(
+                    "bad-spec",
+                    f"generate source caps at {MAX_GENERATE_EDGES} edges "
+                    f"(requested {n_gen}); push the stream instead",
+                )
+            try:
+                stream, descriptor = _build_query(dict(spec))
+            except SystemExit as e:
+                raise _Refused("bad-spec", str(e))
+            cfg = stream.cfg
+        else:
+            raise _Refused(
+                "bad-spec", f"unknown source {source_kind!r} (push/generate)"
+            )
+
+        state_bytes = descriptor.state_nbytes(cfg)
+
+        resume_edges = 0
+        w = cfg.ingest_window_edges
+        if checkpoint_path and source_kind == "push" and w:
+            # the drain/restart cursor: how many whole windows the job's
+            # positional checkpoint already covers (the same snapshot the
+            # merge loop skips by on replay — consistent by construction)
+            last_window, _gdone = descriptor._restored_position(
+                cfg, checkpoint_path, True
+            )
+            resume_edges = (last_window + 1) * w
+
+        from gelly_streaming_tpu.io.sources import NetworkEdgeSource
+        from gelly_streaming_tpu.io.wire import BDV_MAX_ID_BITS
+
+        source = None
+        if source_kind == "push":
+            try:
+                source = NetworkEdgeSource(
+                    cfg,
+                    cfg.batch_size,
+                    resume_edges=resume_edges,
+                    max_queued_batches=self.cfg.ingest_queue_batches,
+                    on_data=self.manager.poke,
+                )
+            except ValueError as e:
+                raise _Refused("bad-spec", str(e))
+        sj = _ServedJob(
+            name,
+            tenant.tenant,
+            cfg,
+            descriptor,
+            source,
+            checkpoint_path,
+            self.cfg.result_buffer_records,
+        )
+        sj.accept_bdv = bool(
+            getattr(descriptor, "order_free", False)
+            and cfg.vertex_capacity <= (1 << BDV_MAX_ID_BITS)
+        )
+        # check -> submit -> register is one atomic admission step: without
+        # the serialization, two concurrent submits could both pass the
+        # tenant caps before either registers
+        with self._admission:
+            self._admit_tenant(tenant, state_bytes)
+            try:
+                if source is not None:
+                    build = lambda: iter(  # noqa: E731 — OutputStream contract
+                        source.stream().aggregate(
+                            descriptor, checkpoint_path=checkpoint_path
+                        )
+                    )
+                    job = self.manager.submit(
+                        build,
+                        name=key,
+                        sink=sj.sink,
+                        weight=weight * tenant.weight,
+                        checkpoint_path=checkpoint_path,
+                        state_bytes=state_bytes,
+                        edges_per_record=w or 0,
+                        ready=source.ready,
+                    )
+                else:
+                    job = self.manager.submit_aggregation(
+                        stream,
+                        descriptor,
+                        name=key,
+                        sink=sj.sink,
+                        weight=weight * tenant.weight,
+                        checkpoint_path=checkpoint_path,
+                    )
+            except AdmissionError as e:
+                metrics.tenant_add(
+                    tenant.tenant, "tenant_admission_rejections", 1
+                )
+                raise _Refused("admission", str(e))
+            sj.job = job
+            with self._lock:
+                old = self._jobs.get(key)
+                self._jobs[key] = sj
+        if old is not None:
+            old.abandon()  # a terminal predecessor's buffered records go
+        metrics.tenant_add(tenant.tenant, "tenant_jobs_submitted", 1)
+        return (
+            {
+                "ok": True,
+                "job": name,
+                "resume_edges": resume_edges,
+                "batch": cfg.batch_size,
+                "window_edges": cfg.ingest_window_edges,
+                "capacity": cfg.vertex_capacity,
+                "accept_bdv": sj.accept_bdv,
+                "state_bytes": state_bytes,
+                "weight": weight * tenant.weight,
+                "checkpoint": bool(checkpoint_path),
+            },
+            b"",
+            False,
+        )
+
+    def _admit_tenant(self, tenant: TenantConfig, new_state_bytes: int) -> None:
+        """Per-tenant admission on top of the manager's global caps; caller
+        gets a typed refusal, the counters get the rejection."""
+        if not (tenant.max_jobs or tenant.max_state_bytes):
+            return
+        with self._lock:
+            live = [
+                sj
+                for sj in self._jobs.values()
+                if sj.tenant == tenant.tenant
+                and sj.job is not None
+                and not sj.job._state_in(*JobState.TERMINAL)
+            ]
+        if tenant.max_jobs and len(live) >= tenant.max_jobs:
+            metrics.tenant_add(tenant.tenant, "tenant_admission_rejections", 1)
+            raise _Refused(
+                "admission",
+                f"tenant job cap reached: {len(live)} live jobs >= "
+                f"max_jobs={tenant.max_jobs}",
+            )
+        if tenant.max_state_bytes:
+            held = sum(sj.job.state_bytes for sj in live)
+            if held + new_state_bytes > tenant.max_state_bytes:
+                metrics.tenant_add(
+                    tenant.tenant, "tenant_admission_rejections", 1
+                )
+                raise _Refused(
+                    "admission",
+                    f"tenant state-byte cap reached: {held} held + "
+                    f"{new_state_bytes} requested > "
+                    f"max_state_bytes={tenant.max_state_bytes}",
+                )
+
+    def _h_push(self, tenant, header, payload):
+        sj = self._served(tenant, header)
+        if sj.source is None:
+            raise _Refused(
+                "bad-spec", f"job {sj.name!r} is not a push-source job"
+            )
+        kind = header.get("kind", "wire")
+        bucket = self._buckets.get(tenant.tenant)
+        if bucket is not None:
+            sleep_s = bucket.reserve(len(payload))
+            if sleep_s > 0:
+                # throttle THIS connection's thread: the client's socket
+                # backs up, the scheduler never notices
+                metrics.tenant_add(tenant.tenant, "tenant_throttle_s", sleep_s)
+                time.sleep(sleep_s)
+        from gelly_streaming_tpu.io import wire as wire_mod
+        from gelly_streaming_tpu.io.sources import SourceQuiesced
+
+        buf = np.frombuffer(payload, np.uint8)
+        try:
+            if kind == "wire":
+                width = wire_mod.width_for_capacity(sj.cfg.vertex_capacity)
+                n = self._push_with_backpressure(sj, buf, width)
+            elif kind == "bdv":
+                if not sj.accept_bdv:
+                    raise _Refused(
+                        "bdv-refused",
+                        f"job {sj.name!r} does not accept BDV buffers "
+                        "(order-sensitive query or capacity > 2^28)",
+                    )
+                width = (wire_mod.BDV, sj.cfg.vertex_capacity)
+                n = self._push_with_backpressure(sj, buf, width)
+            elif kind == "tail":
+                count = int(header.get("count", -1))
+                ids = np.frombuffer(payload, "<i4")
+                if count <= 0 or len(ids) != 2 * count:
+                    raise ValueError(
+                        f"tail payload holds {len(ids)} int32s; 'count': "
+                        f"{count} needs exactly {2 * max(count, 0)}"
+                    )
+                n = self._push_with_backpressure(
+                    sj, None, None, tail=(ids[:count], ids[count:])
+                )
+            else:
+                raise _Refused(
+                    "bad-spec", f"unknown push kind {kind!r} (wire/bdv/tail)"
+                )
+        except ValueError as e:
+            # a well-formed frame carrying a bad wire buffer: refuse the
+            # BUFFER, keep the connection (the client can correct and go on)
+            metrics.tenant_add(tenant.tenant, "tenant_ingest_rejects", 1)
+            return protocol.error_reply(str(e), code="bad-wire"), b"", False
+        except SourceQuiesced as e:
+            return protocol.error_reply(str(e), code="quiesced"), b"", False
+        metrics.tenant_add(tenant.tenant, "tenant_ingest_edges", n)
+        metrics.tenant_add(
+            tenant.tenant, "tenant_ingest_wire_bytes", len(payload)
+        )
+        metrics.tenant_add(tenant.tenant, "tenant_ingest_raw_bytes", 8 * n)
+        metrics.tenant_high_water(
+            tenant.tenant, "tenant_ingest_queue_hwm", sj.source.queued_batches
+        )
+        return (
+            {
+                "ok": True,
+                "accepted": n,
+                "queued_batches": sj.source.queued_batches,
+                "edges_accepted": sj.source.edges_accepted,
+            },
+            b"",
+            False,
+        )
+
+    def _push_with_backpressure(self, sj: _ServedJob, buf, width, tail=None) -> int:
+        """Blocking push with bounded waits: a full ingest queue
+        backpressures this connection (the client's TCP window fills
+        behind us), but a server stop — or the job reaching a terminal
+        state, whose dead generator would never drain the queue again —
+        still unsticks the thread with a typed refusal instead of a
+        forever-wedged connection."""
+        import queue as _queue
+
+        while True:
+            try:
+                # 0.25 s slices re-validate on retry — negligible next to
+                # the wait itself, and only paid when the queue is full
+                if tail is not None:
+                    return sj.source.push_tail(*tail, timeout=0.25)
+                return sj.source.push_wire(buf, width, timeout=0.25)
+            except _queue.Full:
+                if self._stop.is_set():
+                    raise _Refused("shutting-down", "server is stopping")
+                job = sj.job
+                if job is not None and job._state_in(*JobState.TERMINAL):
+                    raise _Refused(
+                        "terminal",
+                        f"job {sj.name!r} is {job.state}: its queue will "
+                        "never drain; stop pushing",
+                    )
+
+    def _h_eos(self, tenant, header, payload):
+        sj = self._served(tenant, header)
+        if sj.source is None:
+            raise _Refused(
+                "bad-spec", f"job {sj.name!r} is not a push-source job"
+            )
+        sj.source.close()
+        return (
+            {"ok": True, "edges_accepted": sj.source.edges_accepted},
+            b"",
+            False,
+        )
+
+    def _h_results(self, tenant, header, payload):
+        sj = self._served(tenant, header)
+        max_records = max(1, min(int(header.get("max", 256)), 4096))
+        timeout_s = max(0.0, min(float(header.get("timeout_ms", 1000)), 6e4))
+        timeout_s /= 1e3
+        # half the smaller frame cap leaves room for npz container
+        # overhead: the reply must fit BOTH this server's cap and the
+        # client reader's default
+        max_bytes = (
+            min(self.cfg.max_frame_bytes, protocol.DEFAULT_MAX_PAYLOAD) // 2
+        )
+        records, state, eos = sj.fetch(max_records, timeout_s, max_bytes)
+        bio = _io.BytesIO()
+        arrays = {
+            f"r{i}_{j}": leaf
+            for i, leaves in enumerate(records)
+            for j, leaf in enumerate(leaves)
+        }
+        np.savez(bio, **arrays)
+        metrics.tenant_add(
+            tenant.tenant, "tenant_records_fetched", len(records)
+        )
+        err = sj.job.error if sj.job is not None else None
+        return (
+            {
+                "ok": True,
+                "job": sj.name,
+                "count": len(records),
+                "leaves": [len(leaves) for leaves in records],
+                "state": state,
+                "eos": eos,
+                "error": repr(err) if err is not None else None,
+            },
+            bio.getvalue(),
+            False,
+        )
+
+    def _h_status(self, tenant, header, payload):
+        from gelly_streaming_tpu.runtime.serve import _status_lines
+
+        status = self.manager.status()
+        # tenant-scoped view: every other verb refuses cross-tenant job
+        # access (_served), so the observability verb must not leak other
+        # tenants' job names, volumes, or rejection counts — the totals
+        # and admitted-byte figures are recomputed over the tenant's own
+        # rows for the same reason (process-wide aggregates minus your own
+        # rows IS the other tenants' volume)
+        prefix = f"{tenant.tenant}/"
+        rows = {
+            k: v for k, v in status["jobs"].items() if k.startswith(prefix)
+        }
+        totals = {}
+        for row in rows.values():
+            for key, val in row.items():
+                if key.startswith("job_") and isinstance(val, (int, float)):
+                    if key.endswith("_hwm"):  # peaks aggregate as max
+                        totals[key] = max(totals.get(key, 0), val)
+                    else:
+                        totals[key] = totals.get(key, 0) + val
+        status = dict(
+            status,
+            jobs=rows,
+            totals=totals,
+            admitted_state_bytes=sum(
+                row.get("state_bytes", 0) for row in rows.values()
+            ),
+        )
+        with self._lock:
+            n_conns = len(self._conns)
+            n_jobs = sum(
+                1 for sj in self._jobs.values() if sj.tenant == tenant.tenant
+            )
+        reply = {
+            "ok": True,
+            "status": status,
+            "tenants": {tenant.tenant: metrics.tenant_stats(tenant.tenant)},
+            "server": {
+                "connections": n_conns,
+                "served_jobs": n_jobs,
+                "port": self._port,
+            },
+            "lines": _status_lines(status),
+        }
+        return reply, b"", False
+
+    def _lifecycle(self, tenant, header, op):
+        sj = self._served(tenant, header)
+        ok = op(sj.job)
+        return (
+            {"ok": True, "result": bool(ok), "state": sj.job.state},
+            b"",
+            False,
+        )
+
+    def _h_pause(self, tenant, header, payload):
+        return self._lifecycle(tenant, header, self.manager.pause)
+
+    def _h_resume(self, tenant, header, payload):
+        return self._lifecycle(tenant, header, self.manager.resume)
+
+    def _h_cancel(self, tenant, header, payload):
+        return self._lifecycle(
+            tenant, header, lambda job: self.manager.cancel(job, wait=True)
+        )
+
+    def _h_drain(self, tenant, header, payload):
+        """Graceful drain: quiesce sources, flush in-flight windows through
+        the normal completion-queue cancel path, read back the positional
+        checkpoints, reply with resume cursors.
+
+        The cursor is derived from the CHECKPOINT after the flush — the one
+        artifact a restart actually reads — so cursor and resumed fold
+        cannot disagree.  Edges the client pushed past the cursor were
+        never folded into a saved window; re-pushing them from the cursor
+        is the at-least-once overlap the checkpoint contract already pins.
+        """
+        names = header.get("jobs")
+        with self._lock:
+            targets = [
+                sj
+                for sj in self._jobs.values()
+                if sj.tenant == tenant.tenant
+                and (names is None or sj.name in names)
+            ]
+        cursors = {}
+        for sj in targets:
+            if sj.source is not None:
+                sj.source.quiesce()
+            job = sj.job
+            if job is not None and not job._state_in(*JobState.TERMINAL):
+                self.manager.cancel(job, wait=True, timeout=60.0)
+            cursor = None
+            w = sj.cfg.ingest_window_edges
+            if sj.checkpoint_path and w:
+                last_window, _gdone = sj.descriptor._restored_position(
+                    sj.cfg, sj.checkpoint_path, True
+                )
+                cursor = (last_window + 1) * w
+            cursors[sj.name] = {
+                "resume_edges": cursor,
+                "checkpoint": bool(sj.checkpoint_path),
+                "state": job.state if job is not None else "PENDING",
+                "records_pending": sj.pending_records(),
+            }
+        if header.get("shutdown"):
+            self._shutdown_requested.set()
+        return {"ok": True, "cursors": cursors}, b"", False
+
+    def _h_shutdown(self, tenant, header, payload):
+        self._shutdown_requested.set()
+        return {"ok": True}, b"", True

@@ -349,6 +349,10 @@ def _job_zero() -> dict:
         # rounds the job was skipped because its output queue was full
         # (the slow-sink isolation boundary doing its job)
         "job_queue_full_skips": 0,
+        # rounds the job was skipped because its source had no complete
+        # window queued (the network-ingest isolation boundary: a slow or
+        # dead client idles ITS job, never the scheduler round)
+        "job_source_wait_skips": 0,
         # deepest output-queue occupancy seen (sink lag indicator)
         "job_queue_depth_hwm": 0,
     }
@@ -419,6 +423,101 @@ def reset_job_stats() -> None:
     with _JOB_LOCK:
         _JOB_COUNTERS.clear()
         _JOB_TOTALS = _job_zero()
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant counter scoping (the streaming RPC serving plane, ISSUE 8,
+# runtime/server.py).  Connection handler threads, the drain path, and
+# status() readers all touch these registries at once, so every access goes
+# through _TENANT_LOCK — same discipline (and the same analyzer pin) as the
+# per-job registries above.  Aggregates are SUMS for counters and MAX for
+# high-water marks, mirroring job_totals().
+
+
+_TENANT_LOCK = threading.Lock()
+
+
+def _tenant_zero() -> dict:
+    return {
+        # request frames this tenant authenticated (every verb)
+        "tenant_requests": 0,
+        # jobs this tenant submitted through the serving plane
+        "tenant_jobs_submitted": 0,
+        # submits refused by tenant or global admission control
+        "tenant_admission_rejections": 0,
+        # edges this tenant pushed over the network ingest path
+        "tenant_ingest_edges": 0,
+        # wire bytes those pushes carried (the socket cost)
+        "tenant_ingest_wire_bytes": 0,
+        # what the same edges would cost as raw int32 pairs (8 B/edge)
+        "tenant_ingest_raw_bytes": 0,
+        # push frames refused by the wire-format guards (size/id bounds)
+        "tenant_ingest_rejects": 0,
+        # seconds this tenant's connections slept in the ingest rate limiter
+        "tenant_throttle_s": 0.0,
+        # emission records delivered to this tenant's results fetches
+        "tenant_records_fetched": 0,
+        # deepest per-source decoded-batch queue occupancy seen
+        "tenant_ingest_queue_hwm": 0,
+    }
+
+
+# tenant id -> counter dict; entries appear at first bump, like jobs
+_TENANT_COUNTERS: dict = {}  # guarded-by: _TENANT_LOCK
+_TENANT_TOTALS = _tenant_zero()  # guarded-by: _TENANT_LOCK
+
+
+def tenant_add(tenant: str, key: str, amount: float) -> None:
+    """Accumulate a per-tenant counter AND its module aggregate."""
+    with _TENANT_LOCK:
+        counters = _TENANT_COUNTERS.get(tenant)
+        if counters is None:
+            counters = _TENANT_COUNTERS[tenant] = _tenant_zero()
+        counters[key] += amount
+        _TENANT_TOTALS[key] += amount
+
+
+def tenant_high_water(tenant: str, key: str, value: float) -> None:
+    """Raise a per-tenant high-water mark (module aggregate keeps the max)."""
+    with _TENANT_LOCK:
+        counters = _TENANT_COUNTERS.get(tenant)
+        if counters is None:
+            counters = _TENANT_COUNTERS[tenant] = _tenant_zero()
+        if value > counters[key]:
+            counters[key] = value
+        if value > _TENANT_TOTALS[key]:
+            _TENANT_TOTALS[key] = value
+
+
+def tenant_stats(tenant: str) -> dict:
+    """One tenant's counters (zeros for a tenant that never bumped any)."""
+    with _TENANT_LOCK:
+        return dict(_TENANT_COUNTERS.get(tenant) or _tenant_zero())
+
+
+def all_tenant_stats() -> dict:
+    """{tenant id -> counter dict} snapshot across every tenant seen —
+    surfaced by the server's ``status`` verb next to the per-job rows and
+    by bench.py's serving sweep beside ``job_stats``/``wire_stats``."""
+    with _TENANT_LOCK:
+        return {t: dict(c) for t, c in _TENANT_COUNTERS.items()}
+
+
+def tenant_totals() -> dict:
+    """Module aggregates over all tenants (sums; max for high-water)."""
+    with _TENANT_LOCK:
+        out = dict(_TENANT_TOTALS)
+    out["tenant_throttle_s"] = round(out["tenant_throttle_s"], 4)
+    return out
+
+
+def reset_tenant_stats() -> None:
+    """Drop every per-tenant row and zero the aggregates (call before a
+    measurement window, read ``all_tenant_stats`` after)."""
+    global _TENANT_TOTALS
+    with _TENANT_LOCK:
+        _TENANT_COUNTERS.clear()
+        _TENANT_TOTALS = _tenant_zero()
 
 
 def compile_cache_stats() -> dict:

@@ -1,0 +1,33 @@
+"""Seeded bug: the serving plane's connection registry touched OUTSIDE the
+server lock.
+
+The fixture for the lock-discipline pass over runtime/server.py's
+discipline: the connection set is ``# guarded-by: _lock`` because the
+accept loop adds while connection handlers discard and shutdown iterates —
+an unlocked len()-check-then-add races two accepts past the connection
+cap, and an unlocked discard during shutdown's iteration throws.
+
+Expected findings: exactly two UNGUARDED — the unlocked read in the cap
+check and the unlocked add.  Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class BadServer:
+    def __init__(self, max_connections: int):
+        self._max = max_connections
+        self._lock = threading.Lock()
+        self._conns = set()  # guarded-by: _lock
+
+    def try_accept(self, sock) -> bool:
+        # BUG: check-then-add without the server lock — two concurrent
+        # accepts both pass the cap check and both register
+        if len(self._conns) >= self._max:
+            return False
+        self._conns.add(sock)
+        return True
+
+    def teardown(self, sock) -> None:
+        with self._lock:
+            self._conns.discard(sock)

@@ -1,0 +1,35 @@
+"""Lock-discipline corpus (clean): the serving plane's connection registry
+mutated only under the server lock.
+
+The server's pattern (runtime/server.py): the accept loop, every
+connection handler's teardown, and the shutdown path all touch the
+connection set and the served-job registry concurrently, so both are
+``# guarded-by: _lock`` and every access takes ``with self._lock:`` —
+the count-check-then-add on accept is one atomic step, so the connection
+cap cannot be raced past.  Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class GoodServer:
+    def __init__(self, max_connections: int):
+        self._max = max_connections
+        self._lock = threading.Lock()
+        self._conns = set()  # guarded-by: _lock
+        self._jobs = {}  # guarded-by: _lock
+
+    def try_accept(self, sock) -> bool:
+        with self._lock:
+            if len(self._conns) >= self._max:
+                return False
+            self._conns.add(sock)
+            return True
+
+    def teardown(self, sock) -> None:
+        with self._lock:
+            self._conns.discard(sock)
+
+    def lookup(self, key):
+        with self._lock:
+            return self._jobs.get(key)

@@ -153,6 +153,44 @@ def test_corpus_jobstate():
     assert _analyze("good_jobstate.py") == []
 
 
+def test_nested_with_collects_inner_lock():
+    """A `with self._lock:` nested directly inside another with-block must
+    still collect its lock for the body (the serving plane's admission
+    section hit this: check() used to recurse INTO the inner With without
+    dispatching it, losing the lock and false-positive-flagging guarded
+    registry writes)."""
+    findings = _src(
+        """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._admission = threading.Lock()
+                self._lock = threading.Lock()
+                self._reg = {}  # guarded-by: _lock
+
+            def f(self, key, value):
+                with self._admission:
+                    with self._lock:
+                        self._reg[key] = value
+        """
+    )
+    assert findings == []
+
+
+def test_corpus_server():
+    """The serving-plane fixtures (ISSUE 8): the connection registry every
+    accept/teardown/shutdown path touches is '# guarded-by:' the server
+    lock; an unlocked check-then-add races two accepts past the
+    connection cap."""
+    findings = _analyze("bad_server.py")
+    assert _codes(findings) == ["UNGUARDED", "UNGUARDED"]
+    assert all("self._conns" in f.message for f in findings)
+    assert all("_lock" in f.message for f in findings)
+    assert _analyze("good_server.py") == []
+
+
 def test_corpus_traceif():
     assert _codes(_analyze("bad_traceif.py")) == [
         "TRACECAST",
