@@ -558,8 +558,12 @@ def _serving_bench(
     inproc_eps_4 = inproc_run(4)
 
     metrics.reset_tenant_stats()
+    # the server-side histograms are the bench's second latency source:
+    # reset them so the sweep's quantiles cover exactly these runs
+    metrics.reset_histograms()
     out = {"serving_inprocess_eps_4": round(inproc_eps_4, 1)}
     latencies = []
+    server_snap = None
     for k in clients:
         first_emit = {}
         errors = []
@@ -628,6 +632,16 @@ def _serving_bench(
             for t in threads:
                 t.join()
             wall = time.perf_counter() - t0
+            if k == max(clients) and not errors:
+                # source the sweep's latency quantiles from the SERVER'S
+                # own bounded histograms through the metrics verb — the
+                # cross-check for the client-side probe above, and the
+                # path gelly-top reads in production
+                try:
+                    with GellyClient("127.0.0.1", server.port) as mc:
+                        server_snap = mc.metrics()
+                except Exception:
+                    server_snap = None  # probe numbers still stand
         if errors:
             raise errors[0]
         out[f"serving_eps_{k}"] = round(k * n / wall, 1)
@@ -661,6 +675,44 @@ def _serving_bench(
         / max(totals["tenant_ingest_edges"], 1),
         3,
     )
+    # histogram-derived quantiles BESIDE the probe numbers (never instead:
+    # the probe measures what a client saw, the histograms what the server
+    # measured itself; the ratio is the cross-check).  The tenant-scoped
+    # submit-to-first row is stamped at the server's sink, so it excludes
+    # the final results-fetch RTT the probe pays — expect hist <= probe.
+    hist_row = None
+    if server_snap is not None:
+        hist_row = (
+            server_snap.get("histograms", {})
+            .get("tenants", {})
+            .get("default", {})
+            .get("submit_to_first_emission_ms")
+        )
+    if hist_row and hist_row.get("count"):
+        out["serving_hist_submit_to_first_emission_p50_ms"] = hist_row[
+            "p50_ms"
+        ]
+        out["serving_hist_submit_to_first_emission_p99_ms"] = hist_row[
+            "p99_ms"
+        ]
+        out["serving_hist_vs_probe_p50_ratio"] = round(
+            hist_row["p50_ms"]
+            / max(out["serving_submit_to_first_emission_p50_ms"], 1e-9),
+            3,
+        )
+    if server_snap is not None:
+        # compact global-scope histogram snapshots for the bench JSON
+        out["serving_histograms"] = {
+            name: {
+                "count": snap["count"],
+                "p50_ms": snap["p50_ms"],
+                "p99_ms": snap["p99_ms"],
+                "max_ms": snap["max_ms"],
+            }
+            for name, snap in server_snap.get("histograms", {})
+            .get("global", {})
+            .items()
+        }
     return out
 
 

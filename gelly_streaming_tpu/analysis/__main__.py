@@ -44,10 +44,12 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--paths",
         nargs="+",
-        default=["core", "io", "library", "ops", "parallel", "runtime"],
+        default=["core", "io", "library", "ops", "parallel", "runtime", "utils"],
         help="files/directories to scan; bare names resolve inside the "
         "gelly_streaming_tpu package (default: core io library ops "
-        "parallel runtime)",
+        "parallel runtime utils — utils hosts the tracing flight "
+        "recorder and metrics registries whose lock discipline the "
+        "lock pass pins)",
     )
     parser.add_argument(
         "--select",

@@ -18,6 +18,7 @@ operator state is not checkpointed (SURVEY.md §5.3-4).
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Iterator, Optional
 
 import jax
@@ -28,6 +29,7 @@ from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
 from gelly_streaming_tpu.core.windows import WindowPane, stream_panes
+from gelly_streaming_tpu.utils import metrics, tracing
 
 
 @jax.jit
@@ -1083,6 +1085,11 @@ class SummaryAggregation:
         # int32 arenas per pane x panes across the prefetch + completion
         # queues), so steady state recycles instead of reallocating
         pool = async_exec.ArenaPool(per_shape=2 * depth + 6)
+        # window spans originate HERE, on the prefetcher's pack thread:
+        # each sampled pane gets its trace id before packing and carries
+        # the span through transfer/dispatch/drain in its meta tuple
+        # (sampling off = one branch per pane, nothing else)
+        span_sampler = tracing.sampler(cfg, "windowed")
 
         def prepare(pane: WindowPane):
             already = (0 <= pane.window_id <= skip_through) or (
@@ -1090,7 +1097,13 @@ class SummaryAggregation:
             )
             n = pane.num_edges
             if already or n == 0:
-                return (pane, None), None
+                return (pane, None, None), None
+            span = (
+                span_sampler.begin(pane.window_id)
+                if span_sampler is not None
+                else None
+            )
+            t_pack = time.perf_counter()
             # destination binning rides this pack thread too (order-free
             # folds only; no-op otherwise) — the dispatch loop never sorts
             pane = self._maybe_bin_pane(cfg, pane)
@@ -1110,10 +1123,13 @@ class SummaryAggregation:
                     return out
 
                 val = jax.tree.map(pad, pane.val)
-            return (pane, (src, dst, mask)), (src, dst, val, mask)
+            if span is not None:
+                span.mark("pack", t_pack)
+                span.annotate(edges=n)
+            return (pane, (src, dst, mask), span), (src, dst, val, mask)
 
         def fold_prepared(item):
-            (pane, arenas), dev = item
+            (pane, arenas, _span), dev = item
             if arenas is None:
                 return None
             src_d, dst_d, val_d, mask_d = dev
@@ -1122,7 +1138,7 @@ class SummaryAggregation:
             )
 
         def release(item):
-            (pane, arenas), _dev = item
+            (pane, arenas, _span), _dev = item
             if arenas is not None:
                 pool.release(*arenas)  # arena-live-until: drain
 
@@ -1346,6 +1362,9 @@ class SummaryAggregation:
                     # legacy snapshot layout: a bare summary pytree with
                     # no stream position (pre-position checkpoints)
                     running = load_state(checkpoint_path, self.initial_state(cfg))
+        # span sampling resolved ONCE: when off (the default) the loop
+        # below pays a single `is not None` branch per window
+        span_sampler = tracing.sampler(cfg, "merge")
         for item in panes:
             pane, payload = item if unwrap else (item, item)
             already_folded = (0 <= pane.window_id <= start_after) or (
@@ -1353,6 +1372,12 @@ class SummaryAggregation:
             )
             if already_folded:
                 continue  # folded before the snapshot: replay-safe
+            span = (
+                span_sampler.begin(pane.window_id)
+                if span_sampler is not None
+                else None
+            )
+            t_item = time.perf_counter()
             pane_summary = fold_pane(payload)
             if pane_summary is None:
                 continue
@@ -1363,6 +1388,14 @@ class SummaryAggregation:
             else:
                 running = self._combine_j(running, pane_summary)
             out = self.transform(running)
+            t_emit = time.perf_counter()
+            metrics.hist_record(
+                "window_close_to_emission_ms", (t_emit - t_item) * 1e3
+            )
+            if span is not None:
+                span.mark("dispatch", t_item, t_emit)
+                span.mark("emit", t_emit)
+                span_sampler.record(span, t_emit)
             # Emit BEFORE snapshotting: a crash between the two re-emits
             # this window on recovery (at-least-once emission) instead of
             # dropping it (at-most-once would lose sink data).

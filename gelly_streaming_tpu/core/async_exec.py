@@ -42,7 +42,7 @@ from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
-from gelly_streaming_tpu.utils import metrics
+from gelly_streaming_tpu.utils import metrics, tracing
 
 
 def resolve_depth(cfg) -> int:
@@ -191,6 +191,10 @@ def pipelined(
             "pipeline_drain_stall_s", time.perf_counter() - t0
         )
         metrics.pipeline_add("pipeline_windows_drained", 1)
+        span = tracing.find_span(meta) if tracing.active() else None
+        if span is not None:
+            span.mark("drain", t0)
+            tracing.flight_recorder().record(span)
         return out
 
     with wire.Prefetcher(
@@ -208,7 +212,12 @@ def pipelined(
                 metrics.pipeline_add(
                     "pipeline_dispatch_stall_s", time.perf_counter() - t0
                 )
-                pending.append((meta, dispatch(meta, dev)))
+                span = tracing.find_span(meta) if tracing.active() else None
+                t_disp = time.perf_counter() if span is not None else 0.0
+                handle = dispatch(meta, dev)
+                if span is not None:
+                    span.mark("dispatch", t_disp)
+                pending.append((meta, handle))
                 metrics.pipeline_add("pipeline_windows_dispatched", 1)
                 metrics.pipeline_high_water(
                     "pipeline_inflight_high_water", len(pending)
@@ -322,13 +331,25 @@ def async_merge_loop(
 
     def drain_one():
         nonlocal drained_through, drained_global
-        wid, rec, summary, payload = pending.popleft()
+        wid, rec, summary, payload, span, t_item = pending.popleft()
         metrics.pipeline_add("pipeline_windows_drained", 1)
+        t_drain = time.perf_counter()
         if release is not None and payload is not None:
             # the emission depends on this window's fold: its completion
             # proves the fold consumed the arena's host memory
             wait_ready(rec)
             release(payload)  # arena-live-until: drain — this IS the drain
+        t_emit = time.perf_counter()
+        # emission latency for EVERY window (bounded histogram, one lock
+        # per window — same cost class as the pipeline counters above);
+        # span recording only for the sampled ones
+        metrics.hist_record(
+            "window_close_to_emission_ms", (t_emit - t_item) * 1e3
+        )
+        if span is not None:
+            span.mark("drain", t_drain, t_emit)
+            span.mark("emit", t_emit)
+            tracing.flight_recorder().record(span)
         return wid, rec, summary
 
     panes_it = iter(panes)
@@ -349,6 +370,11 @@ def async_merge_loop(
             )
             if already_folded:
                 continue  # folded before the snapshot: replay-safe
+            # the span (if this window was sampled at the pack thread)
+            # rides the payload meta; its dispatch stage covers the fold
+            # dispatch + transform + host-fetch kickoff below
+            span = tracing.find_span(payload) if tracing.active() else None
+            t_item = time.perf_counter()
             pane_summary = fold_pane(payload)
             if pane_summary is None:
                 continue
@@ -362,12 +388,16 @@ def async_merge_loop(
             ck = running if checkpoint_path else None
             if ck is not None:
                 start_host_fetch(ck)
+            if span is not None:
+                span.mark("dispatch", t_item)
             pending.append(
                 (
                     pane.window_id,
                     rec,
                     ck,
                     payload if release is not None else None,
+                    span,
+                    t_item,
                 )
             )
             metrics.pipeline_add("pipeline_windows_dispatched", 1)

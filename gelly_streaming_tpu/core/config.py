@@ -114,6 +114,16 @@ class StreamConfig:
     # vertex_capacity <= 2^28.  1 = on, 0 = off (the plain fixed-width
     # oracle), -1 = defer to GELLY_WIRE_COMPRESS (default off).
     wire_compress: int = -1
+    # Per-window span tracing (utils/tracing.py): sample rate in (0, 1]
+    # for the flight-recorder spans that time each window across
+    # pack -> transfer -> dispatch -> drain -> emit.  0 = off (the
+    # default): planes resolve their sampler once outside the loop, so
+    # the hot path pays one branch and nothing else — no clock reads, no
+    # locks, emissions bit-identical with tracing on or off (pinned by
+    # tests/test_tracing.py).  When left at 0 the GELLY_TRACE_SAMPLE env
+    # var may switch it on process-wide (the async_windows pattern).
+    # Sampling is a deterministic stride (every round(1/rate)-th window).
+    trace_sample: float = 0.0
     # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
     # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
     # values trail the watermark behind max seen time by the bound, holding
@@ -151,6 +161,8 @@ class StreamConfig:
             raise ValueError("ingest_workers must be >= 0")
         if self.async_windows < 0:
             raise ValueError("async_windows must be >= 0")
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError("trace_sample must be in [0, 1]")
         if self.sharded_state not in (-1, 0, 1):
             raise ValueError("sharded_state must be -1 (auto), 0, or 1")
         if self.binned_ingest not in (-1, 0, 1):

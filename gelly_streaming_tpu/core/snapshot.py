@@ -16,6 +16,7 @@ target (the reversed stream); ALL keys both endpoints of each edge
 
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -261,14 +262,32 @@ class SnapshotStream:
         identical to the synchronous path.
         """
         from gelly_streaming_tpu.core import async_exec
+        from gelly_streaming_tpu.utils import tracing
 
         kernel = self._jit_kernel(bucket_kernel, extra)
+        # spans originate on the prefetcher's pack thread (trace id +
+        # pack timing); transfer/dispatch/drain marks ride the generic
+        # pipeline (io/wire.Prefetcher + async_exec.pipelined)
+        span_sampler = tracing.sampler(self._stream.cfg, "snapshot")
 
         def prepare(pane: WindowPane):
+            t_pack = _time.perf_counter()
             padded = self._padded_pane_edges(pane)
             if padded is None:
-                return (pane.window_id, 0), None
-            return (pane.window_id, 1), padded
+                # edge-less pane: no span — it must not consume a stride
+                # slot (sampling stays every-Nth FOLDED window) nor leak
+                # a trace id that never reaches the recorder
+                return (pane.window_id, 0, None), None
+            span = (
+                span_sampler.begin(pane.window_id)
+                if span_sampler is not None
+                else None
+            )
+            if span is not None:
+                # the span's clock starts where the pack work did
+                span.t0 = t_pack
+                span.mark("pack", t_pack)
+            return (pane.window_id, 1, span), padded
 
         def dispatch(meta, dev):
             if dev is None:

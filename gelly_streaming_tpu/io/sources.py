@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import queue
 import threading
+import time
 from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
@@ -19,6 +20,7 @@ from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.stream import EdgeStream
 from gelly_streaming_tpu.core.types import EdgeBatch
 from gelly_streaming_tpu.io.interning import IdentityInterner, VertexInterner
+from gelly_streaming_tpu.utils import metrics
 from gelly_streaming_tpu.utils.native import load_ingest_lib
 
 
@@ -346,7 +348,10 @@ class NetworkEdgeSource:
         return len(s)
 
     def _accept(self, s, d, timeout: Optional[float]) -> None:
-        self._q.put((s, d), timeout=timeout)
+        # enqueue timestamp: the consumer side records queue residency as
+        # the push-to-fold latency histogram (how long a pushed batch
+        # waited before the scheduler folded it)
+        self._q.put((s, d, time.perf_counter()), timeout=timeout)
         with self._lock:
             self._edges_in += len(s)
         wake = self.on_data
@@ -443,12 +448,18 @@ class NetworkEdgeSource:
             yield EdgeBatch.from_arrays(zeros, zeros, pad_to=self.batch)
         while True:
             try:
-                s, d = self._q.get(timeout=0.05)
+                s, d, t_pushed = self._q.get(timeout=0.05)
             except queue.Empty:
                 with self._lock:
                     if self._closed and self._q.empty():
                         return
                 continue
+            # queue residency = push-to-fold latency: this factory is
+            # pulled on the scheduler thread under the job's pull, so the
+            # thread-local job tag scopes the sample to this job too
+            metrics.hist_record(
+                "push_to_fold_ms", (time.perf_counter() - t_pushed) * 1e3
+            )
             with self._lock:
                 self._edges_out += len(s)
             yield EdgeBatch.from_arrays(s, d, pad_to=self.batch)

@@ -849,6 +849,7 @@ class Prefetcher:
         import jax
 
         from ..utils import metrics as _metrics
+        from ..utils import tracing as _tracing
 
         try:
             while True:
@@ -862,9 +863,18 @@ class Prefetcher:
                 if got is self._SENTINEL:
                     return
                 meta, host = got
+                # a sampled window's span rides the meta: time its
+                # device_put as the "transfer" stage (the active() gate
+                # keeps untraced processes at zero extra work here)
+                span = (
+                    _tracing.find_span(meta) if _tracing.active() else None
+                )
+                t_put = _time.perf_counter() if span is not None else 0.0
                 # device_put blocks this thread for the transfer; the pack
                 # thread keeps preparing the next items meanwhile
                 dev = None if host is None else jax.device_put(host, self._device)
+                if span is not None and host is not None:
+                    span.mark("transfer", t_put)
                 if not self._put(self._q, (meta, dev)):
                     return
         except BaseException as e:

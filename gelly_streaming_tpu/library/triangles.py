@@ -205,12 +205,12 @@ def pipelined_pane_counts(
         if device_recorder is not None and handle[0] != "const":
             _jax.block_until_ready(handle[1])
             if k >= warmup:
-                device_recorder.latencies_ms.append(
+                device_recorder.record(
                     (_time.perf_counter() - t_close) * 1e3
                 )
         counts.append(_pane_triangle_finish(handle))
         if recorder is not None and k >= warmup:
-            recorder.latencies_ms.append((_time.perf_counter() - t_close) * 1e3)
+            recorder.record((_time.perf_counter() - t_close) * 1e3)
 
     with Prefetcher(stamped(), _pane_prepare, depth=max(depth, 2)) as pf:
         for k, (meta, dev) in enumerate(pf):

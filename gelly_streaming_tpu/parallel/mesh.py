@@ -10,22 +10,37 @@ ownership is ``vertex_id % num_shards`` over the dense interned id space
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gelly_streaming_tpu.utils import tracing
+
 SHARD_AXIS = "shards"
 
 
 def make_mesh(num_shards: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over the first ``num_shards`` devices (default: all)."""
+    t0 = time.perf_counter()
     devs = list(devices if devices is not None else jax.devices())
     n = num_shards or len(devs)
     if n > len(devs):
         raise ValueError(f"requested {n} shards but only {len(devs)} devices")
-    return Mesh(np.array(devs[:n]), (SHARD_AXIS,))
+    mesh = Mesh(np.array(devs[:n]), (SHARD_AXIS,))
+    # setup-time observability: when tracing is on, the topology a run
+    # built (and what it cost) lands in the flight recorder next to the
+    # window spans — the first thing a mesh-plane post-mortem checks
+    tracing.record_event(
+        "mesh",
+        "build",
+        t0,
+        shards=n,
+        platform=devs[0].platform if devs else "none",
+    )
+    return mesh
 
 
 def owner_of(vertex_ids: np.ndarray, num_shards: int) -> np.ndarray:

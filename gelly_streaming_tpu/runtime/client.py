@@ -259,6 +259,26 @@ class GellyClient:
     def status(self) -> dict:
         return self.call({"verb": "status"})[0]
 
+    def metrics(self) -> dict:
+        """The full observability registry (tenant-scoped job/tenant rows
+        + process-plane counters + histograms + span stage aggregates)."""
+        return self.call({"verb": "metrics"})[0]["metrics"]
+
+    def metrics_prometheus(self) -> str:
+        """The same registry rendered in the Prometheus text exposition
+        format (ships as the frame payload)."""
+        _head, payload = self.call(
+            {"verb": "metrics", "format": "prometheus"}
+        )
+        return payload.decode("utf-8")
+
+    def trace(self, n: int = 32) -> dict:
+        """The flight recorder's last ``n`` window spans plus the span
+        stage aggregates: ``{"spans": [...], "tracing_active": bool,
+        "stats": {...}}``.  Empty spans until some run enables
+        ``trace_sample`` / ``GELLY_TRACE_SAMPLE``."""
+        return self.call({"verb": "trace", "n": n})[0]
+
     def pause(self, job: str) -> dict:
         return self.call({"verb": "pause", "job": job})[0]
 
@@ -341,6 +361,22 @@ def main(argv=None) -> int:
     p_results = sub.add_parser("results", help="stream a job's emissions")
     p_results.add_argument("--job", required=True)
 
+    p_metrics = sub.add_parser(
+        "metrics",
+        help="dump the server's observability registry (counters, "
+        "histogram quantiles, span stage aggregates)",
+    )
+    p_metrics.add_argument(
+        "--prometheus",
+        action="store_true",
+        help="print the Prometheus text exposition format instead of JSON",
+    )
+
+    p_trace = sub.add_parser(
+        "trace", help="dump the flight recorder's last N window spans"
+    )
+    p_trace.add_argument("--last", type=int, default=32)
+
     p_cancel = sub.add_parser("cancel", help="cancel a job")
     p_cancel.add_argument("--job", required=True)
 
@@ -418,6 +454,30 @@ def _run_cmd(client: GellyClient, args) -> int:
             shapes = ", ".join(str(leaf.shape) for leaf in rec)
             print(f"record {n}: {len(rec)} leaves [{shapes}]")
         print(f"{n} record(s), end of stream")
+        return 0
+    if args.cmd == "metrics":
+        if args.prometheus:
+            sys.stdout.write(client.metrics_prometheus())
+            return 0
+        import json as _json
+
+        print(_json.dumps(client.metrics(), indent=2, sort_keys=True))
+        return 0
+    if args.cmd == "trace":
+        reply = client.trace(args.last)
+        if not reply["tracing_active"]:
+            print(
+                "tracing is off (enable with trace_sample / "
+                "GELLY_TRACE_SAMPLE on the server)"
+            )
+        for span in reply["spans"]:
+            stages = " ".join(
+                f"{s['stage']}={s['ms']:.2f}" for s in span["stages"]
+            )
+            print(
+                f"#{span['trace_id']} {span['plane']} w={span['window']} "
+                f"total={span['total_ms']:.2f}ms  {stages}"
+            )
         return 0
     if args.cmd == "cancel":
         reply = client.cancel(args.job)

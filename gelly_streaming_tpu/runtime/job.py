@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, List, Optional
 
 
@@ -159,6 +160,14 @@ class Job:
         self._done_evt = threading.Event()
         self._sink_thread: Optional[threading.Thread] = None
         self._manager = None  # set by JobManager.submit
+        # observability (ISSUE 9): admission timestamp for the
+        # submit-to-first-emission histogram, the scheduler's per-quantum
+        # bookkeeping for the queue-wait histogram, and the flight-recorder
+        # dump attached on a FAILED transition for post-mortems
+        self._submit_t = time.perf_counter()
+        self._first_emitted = False  # single-thread: scheduler
+        self._last_quantum_end: Optional[float] = None  # single-thread: scheduler
+        self._trace_dump: Optional[List[dict]] = None  # guarded-by: _lock
 
     # -- read-side API -------------------------------------------------------
 

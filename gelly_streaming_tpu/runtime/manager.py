@@ -45,7 +45,7 @@ from gelly_streaming_tpu.runtime.job import (
     Job,
     JobState,
 )
-from gelly_streaming_tpu.utils import metrics
+from gelly_streaming_tpu.utils import metrics, tracing
 
 
 class JobManager:
@@ -278,9 +278,12 @@ class JobManager:
         with self._lock:
             jobs = dict(self._jobs)
             admitted = self._admitted_bytes
+            dumps = {
+                job_id: job._trace_dump for job_id, job in jobs.items()
+            }
         out = {}
         for job_id, job in jobs.items():
-            out[job_id] = {
+            row = {
                 "state": job.state,
                 "weight": job.weight,
                 "queue_depth": job.queue_depth,
@@ -290,6 +293,14 @@ class JobManager:
                 "error": repr(job.error) if job.error is not None else None,
                 **metrics.job_stats(job_id),
             }
+            latency = metrics.job_latency_snapshot(job_id)
+            if latency:
+                row["latency_ms"] = latency
+            if dumps[job_id] is not None:
+                # the FAILED post-mortem: the flight recorder's last spans
+                # at the moment the job died (see _fail)
+                row["trace"] = dumps[job_id]
+            out[job_id] = row
         return {
             "jobs": out,
             "admitted_state_bytes": admitted,
@@ -400,11 +411,23 @@ class JobManager:
         """Mark FAILED from ANY thread (scheduler pull errors, sink pump
         errors).  Sentinel delivery is DEFERRED to the scheduler — only the
         scheduler thread ever puts into a job's queue, which is what makes
-        its full()-check-then-put_nowait in ``_run_quantum`` race-free."""
+        its full()-check-then-put_nowait in ``_run_quantum`` race-free.
+
+        The FAILED transition snapshots the flight recorder into the job
+        (``status()`` surfaces it as ``trace``): the last N window spans
+        at the moment of death are the post-mortem — where each recent
+        window's time went — captured before later jobs overwrite the
+        ring.  Empty when tracing never ran; the recorder's lock nests
+        inside the manager lock here and never the other way around.
+        """
+        dump = (
+            tracing.flight_recorder().last(32) if tracing.active() else []
+        )
         with self._lock:
             if job._state_in(*JobState.TERMINAL):
                 return
             job._error = err
+            job._trace_dump = dump
             job._transition(JobState.FAILED)
             self._release(job)
             job._sentinel_pending = True
@@ -478,60 +501,91 @@ class JobManager:
             except BaseException as e:
                 self._fail(job, e)
                 return True
+        t_round = time.perf_counter()
         credits = job.weight * self.cfg.fair_quantum
         pulled = 0
-        for _ in range(credits):
-            if not job._state_in(JobState.RUNNING):
-                break
-            if job._cancel_pending():
-                break
-            if job._out.full():
-                metrics.job_add(job.job_id, "job_queue_full_skips", 1)
-                break
-            if pulled and ready is not None and not ready():
-                # re-check between pulls: each pull drains a window's worth
-                # from the source, so readiness established for the FIRST
-                # pull says nothing about the rest of the quantum — a pull
-                # past the queued data would block the scheduler thread on
-                # that job's producer (the wedge the gate exists to prevent)
-                break
-            if job._it is None:
-                build = job._build
-                if build is None:
-                    break  # raced a concurrent terminal transition
-                # lazy build: first schedule pays the query's setup
-                # (including any cold compile) on the scheduler thread —
-                # cooperative by design, and amortized by the shared cache
-                job._it = iter(build())
-            t0 = time.perf_counter()
-            try:
-                rec = next(job._it)
-            except StopIteration:
-                with self._lock:
-                    job._transition(JobState.DRAINING)
-                self._enqueue_sentinel(job)
+        # tag this thread with the job id for the duration of its pulls:
+        # histograms recorded deep inside the merge loops / network source
+        # (close-to-emission, push-to-fold) land in this job's rows too
+        prev_scope = metrics.set_hist_job(job.job_id)
+        try:
+            for _ in range(credits):
+                if not job._state_in(JobState.RUNNING):
+                    break
+                if job._cancel_pending():
+                    break
+                if job._out.full():
+                    metrics.job_add(job.job_id, "job_queue_full_skips", 1)
+                    break
+                if pulled and ready is not None and not ready():
+                    # re-check between pulls: each pull drains a window's
+                    # worth from the source, so readiness established for
+                    # the FIRST pull says nothing about the rest of the
+                    # quantum — a pull past the queued data would block the
+                    # scheduler thread on that job's producer (the wedge
+                    # the gate exists to prevent)
+                    break
+                if job._it is None:
+                    build = job._build
+                    if build is None:
+                        break  # raced a concurrent terminal transition
+                    # lazy build: first schedule pays the query's setup
+                    # (including any cold compile) on the scheduler thread —
+                    # cooperative by design, amortized by the shared cache
+                    job._it = iter(build())
+                t0 = time.perf_counter()
+                try:
+                    rec = next(job._it)
+                except StopIteration:
+                    with self._lock:
+                        job._transition(JobState.DRAINING)
+                    self._enqueue_sentinel(job)
+                    pulled += 1
+                    break
+                except BaseException as e:
+                    self._fail(job, e)
+                    pulled += 1
+                    break
+                t_rec = time.perf_counter()
+                metrics.job_add(job.job_id, "job_dispatch_s", t_rec - t0)
+                metrics.job_add(job.job_id, "job_dispatches", 1)
+                metrics.job_add(job.job_id, "job_records", 1)
+                if not job._first_emitted:
+                    job._first_emitted = True
+                    metrics.hist_record(
+                        "submit_to_first_emission_ms",
+                        (t_rec - job._submit_t) * 1e3,
+                        job=job.job_id,
+                    )
+                if job.edges_per_record:
+                    metrics.job_add(
+                        job.job_id, "job_edges", job.edges_per_record
+                    )
+                # sole producer is this thread and fullness was checked
+                # above, so put_nowait cannot raise
+                job._out.put_nowait(rec)
+                metrics.job_high_water(
+                    job.job_id, "job_queue_depth_hwm", job._out.qsize()
+                )
                 pulled += 1
-                break
-            except BaseException as e:
-                self._fail(job, e)
-                pulled += 1
-                break
-            metrics.job_add(
-                job.job_id, "job_dispatch_s", time.perf_counter() - t0
-            )
-            metrics.job_add(job.job_id, "job_dispatches", 1)
-            metrics.job_add(job.job_id, "job_records", 1)
-            if job.edges_per_record:
-                metrics.job_add(job.job_id, "job_edges", job.edges_per_record)
-            # sole producer is this thread and fullness was checked above,
-            # so put_nowait cannot raise
-            job._out.put_nowait(rec)
-            metrics.job_high_water(
-                job.job_id, "job_queue_depth_hwm", job._out.qsize()
-            )
-            pulled += 1
+        finally:
+            metrics.set_hist_job(prev_scope)
         if pulled:
+            # scheduler queue wait: the gap from this job's previous
+            # PRODUCTIVE quantum to this one's start — what a closed
+            # window waits before the shared scheduler gets back to its
+            # job.  Recorded only on productive quanta: unproductive
+            # visits (full queue, unready source) neither advance the
+            # anchor nor record, so consumer backpressure never
+            # masquerades as ramping scheduler wait.
+            if job._last_quantum_end is not None:
+                metrics.hist_record(
+                    "sched_queue_wait_ms",
+                    (t_round - job._last_quantum_end) * 1e3,
+                    job=job.job_id,
+                )
             metrics.job_add(job.job_id, "job_sched_rounds", 1)
+            job._last_quantum_end = time.perf_counter()
         return bool(pulled)
 
     def _cancel_now(self, job: Job) -> None:  # single-thread: scheduler

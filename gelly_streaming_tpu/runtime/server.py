@@ -231,6 +231,12 @@ class _ServedJob:
         self.checkpoint_path = checkpoint_path
         self.job: Optional[Job] = None  # set right after manager.submit
         self.accept_bdv = False
+        # serving-plane latency: submit time for the per-TENANT
+        # submit-to-first-emission histogram (the manager records the
+        # per-job row; this one is what the serving bench reads back
+        # through the metrics verb)
+        self.submit_t = time.perf_counter()
+        self._first_emit_done = False  # single-thread: sink pump
         self._cap = max(1, buffer_cap)
         self._cond = threading.Condition()
         # emission records (host leaf-array lists) awaiting a results fetch
@@ -244,6 +250,16 @@ class _ServedJob:
         queue fills, and the scheduler skips that one job's rounds: the
         slow-consumer isolation boundary, end to end."""
         leaves = record_leaves(rec)
+        if not self._first_emit_done:
+            self._first_emit_done = True
+            # scoped rows only: the scheduler already recorded this job's
+            # sample into the global scope (hist_record's default path)
+            metrics.hist_record(
+                "submit_to_first_emission_ms",
+                (time.perf_counter() - self.submit_t) * 1e3,
+                tenant=self.tenant,
+                record_global=False,
+            )
         with self._cond:
             while len(self._records) >= self._cap and not self._abandoned:
                 self._cond.wait(0.1)
@@ -322,6 +338,8 @@ class StreamServer:
         "eos",
         "results",
         "status",
+        "metrics",
+        "trace",
         "pause",
         "resume",
         "cancel",
@@ -615,6 +633,10 @@ class StreamServer:
                     ingest_window_edges=int(spec.get("window_edges", 0)),
                     async_windows=int(spec.get("async_windows", 0)),
                     num_shards=int(spec.get("num_shards", 1)),
+                    # per-job span tracing opt-in: sampled windows land in
+                    # the flight recorder (the trace verb / FAILED
+                    # post-mortems); 0 = off, the zero-overhead default
+                    trace_sample=float(spec.get("trace_sample", 0.0)),
                 )
             except (TypeError, ValueError) as e:
                 raise _Refused("bad-spec", f"bad stream config: {e}")
@@ -941,14 +963,7 @@ class StreamServer:
         rows = {
             k: v for k, v in status["jobs"].items() if k.startswith(prefix)
         }
-        totals = {}
-        for row in rows.values():
-            for key, val in row.items():
-                if key.startswith("job_") and isinstance(val, (int, float)):
-                    if key.endswith("_hwm"):  # peaks aggregate as max
-                        totals[key] = max(totals.get(key, 0), val)
-                    else:
-                        totals[key] = totals.get(key, 0) + val
+        totals = self._totals_over(rows.values())
         status = dict(
             status,
             jobs=rows,
@@ -974,6 +989,87 @@ class StreamServer:
             "lines": _status_lines(status),
         }
         return reply, b"", False
+
+    def _h_metrics(self, tenant, header, payload):
+        """The exposition verb: the full observability registry
+        (utils.metrics.metrics_snapshot) with the per-job and per-tenant
+        sections scoped to the REQUESTING tenant — same disclosure rule as
+        ``status`` (another tenant's job names/volumes must not leak; the
+        process-plane counters — pipeline/wire/comms/compile-cache — and
+        the span stage aggregates are infrastructure figures, shared).
+
+        ``format: "prometheus"`` returns the text exposition format as the
+        frame payload instead of JSON in the header — point a scraper's
+        fetch at ``gelly-client metrics --prometheus`` or GellyClient.
+        """
+        snap = metrics.metrics_snapshot()
+        prefix = f"{tenant.tenant}/"
+        snap["jobs"] = {
+            k: v for k, v in snap["jobs"].items() if k.startswith(prefix)
+        }
+        snap["job_totals"] = self._totals_over(snap["jobs"].values())
+        snap["tenants"] = {tenant.tenant: metrics.tenant_stats(tenant.tenant)}
+        snap["tenant_totals"] = dict(snap["tenants"][tenant.tenant])
+        hists = snap.get("histograms", {})
+        hists["jobs"] = {
+            k: v
+            for k, v in hists.get("jobs", {}).items()
+            if k.startswith(prefix)
+        }
+        hists["tenants"] = {
+            k: v
+            for k, v in hists.get("tenants", {}).items()
+            if k == tenant.tenant
+        }
+        if header.get("format") == "prometheus":
+            from gelly_streaming_tpu.utils.metrics import render_prometheus
+
+            text = render_prometheus(snap).encode("utf-8")
+            return {"ok": True, "format": "prometheus"}, text, False
+        return {"ok": True, "metrics": snap}, b"", False
+
+    @staticmethod
+    def _totals_over(rows) -> dict:
+        """Field-wise totals over a tenant's own job rows (sums; max for
+        high-water marks) — the same recompute rule the status verb uses,
+        so scoped aggregates never include other tenants' volume."""
+        totals: dict = {}
+        for row in rows:
+            for key, val in row.items():
+                if key.startswith("job_") and isinstance(val, (int, float)):
+                    if key.endswith("_hwm"):
+                        totals[key] = max(totals.get(key, 0), val)
+                    else:
+                        totals[key] = totals.get(key, 0) + val
+        return totals
+
+    def _h_trace(self, tenant, header, payload):
+        """Dump the flight recorder's last N window spans.
+
+        An operator/diagnostics surface: spans carry plane names, window
+        ids, and stage timings — no tenant payloads, job names, or graph
+        data — so the process-wide ring is returned as-is (a per-tenant
+        slice would hide exactly the cross-job interference a latency
+        post-mortem is looking for).
+        """
+        from gelly_streaming_tpu.utils import tracing
+
+        try:
+            n = int(header.get("n", 32))
+        except (TypeError, ValueError):
+            raise _Refused("bad-spec", "trace 'n' must be an integer")
+        n = max(1, min(n, 4096))
+        spans = tracing.flight_recorder().last(n) if tracing.active() else []
+        return (
+            {
+                "ok": True,
+                "spans": spans,
+                "tracing_active": tracing.active(),
+                "stats": tracing.span_stats(),
+            },
+            b"",
+            False,
+        )
 
     def _lifecycle(self, tenant, header, op):
         sj = self._served(tenant, header)

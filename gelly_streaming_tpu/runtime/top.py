@@ -1,0 +1,167 @@
+"""``gelly-top``: live observability console for a ``gelly-serve --listen``
+server — the ``top(1)`` analog over the serving plane's ``status`` and
+``metrics`` verbs.
+
+Each frame polls the server once and renders per-job rows (state, records,
+edges/s computed from the delta between polls, queue depth, close-to-
+emission and submit-to-first-emission quantiles from the server's OWN
+bounded histograms — not client-side probes) plus the tenant ingest ledger
+and a pipeline/span header.  ``--once`` prints a single frame and exits
+(what the tests and scripts use); the interactive loop clears the screen
+between frames when stdout is a TTY.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+
+def _fmt_eps(eps: Optional[float]) -> str:
+    if eps is None:
+        return "-"
+    if eps >= 1e6:
+        return f"{eps / 1e6:.1f}M"
+    if eps >= 1e3:
+        return f"{eps / 1e3:.1f}k"
+    return f"{eps:.0f}"
+
+
+def _quantiles(hist_rows: dict, name: str) -> str:
+    """'p50/p99' ms string for one histogram row, '-' when absent."""
+    row = hist_rows.get(name)
+    if not row or not row.get("count"):
+        return "-"
+    return f"{row['p50_ms']:.1f}/{row['p99_ms']:.1f}"
+
+
+def render_frame(
+    status: dict,
+    metrics_snap: dict,
+    prev: Optional[dict],
+    dt: Optional[float],
+) -> list:
+    """One frame's console lines from a status reply + metrics snapshot.
+
+    ``prev``/``dt`` carry the previous poll's per-job edge counters for
+    the eps column (None on the first frame).  Pure function of its
+    inputs so tests can pin the rendering without a terminal.
+    """
+    lines = []
+    srv = status.get("server", {})
+    spans = metrics_snap.get("spans", {})
+    pipeline = metrics_snap.get("pipeline", {})
+    lines.append(
+        f"gelly-top  conns={srv.get('connections', '?')} "
+        f"jobs={srv.get('served_jobs', '?')} port={srv.get('port', '?')}  "
+        f"inflight_hwm={pipeline.get('pipeline_inflight_high_water', 0)} "
+        f"spans={spans.get('recorded', 0)}"
+    )
+    jobs = status.get("status", {}).get("jobs", {})
+    hist_jobs = metrics_snap.get("histograms", {}).get("jobs", {})
+    lines.append(
+        f"{'JOB':<24} {'STATE':<9} {'RECORDS':>8} {'EPS':>8} {'QUEUE':>5} "
+        f"{'CLOSE p50/p99ms':>16} {'1ST-EMIT p50ms':>14}"
+    )
+    for job_id in sorted(jobs):
+        row = jobs[job_id]
+        eps = None
+        if prev is not None and dt and job_id in prev:
+            eps = max(0.0, (row.get("job_edges", 0) - prev[job_id]) / dt)
+        hrows = hist_jobs.get(job_id, {})
+        first = hrows.get("submit_to_first_emission_ms") or {}
+        first_s = (
+            f"{first['p50_ms']:.1f}" if first.get("count") else "-"
+        )
+        lines.append(
+            f"{job_id:<24.24} {row.get('state', '?'):<9} "
+            f"{row.get('job_records', 0):>8} {_fmt_eps(eps):>8} "
+            f"{row.get('queue_depth', 0):>5} "
+            f"{_quantiles(hrows, 'window_close_to_emission_ms'):>16} "
+            f"{first_s:>14}"
+        )
+    tenants = metrics_snap.get("tenants", {})
+    if tenants:
+        lines.append(
+            f"{'TENANT':<24} {'REQS':>7} {'INGEST-EDGES':>12} "
+            f"{'WIRE B/E':>9} {'THROTTLE s':>10} {'REJECTS':>8}"
+        )
+        for tid in sorted(tenants):
+            t = tenants[tid]
+            edges = t.get("tenant_ingest_edges", 0)
+            bpe = (
+                t.get("tenant_ingest_wire_bytes", 0) / edges if edges else 0.0
+            )
+            lines.append(
+                f"{tid:<24.24} {t.get('tenant_requests', 0):>7} "
+                f"{edges:>12} {bpe:>9.2f} "
+                f"{t.get('tenant_throttle_s', 0.0):>10.2f} "
+                f"{t.get('tenant_ingest_rejects', 0):>8}"
+            )
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gelly-top",
+        description="live per-job/per-tenant eps, queue depths, and "
+        "p50/p99 latency from a gelly-serve --listen server's own "
+        "histograms",
+    )
+    parser.add_argument(
+        "--connect", required=True, help="server address, host:port"
+    )
+    parser.add_argument("--token", default="", help="tenant auth token")
+    parser.add_argument(
+        "--interval", type=float, default=2.0, help="seconds between polls"
+    )
+    parser.add_argument(
+        "--once", action="store_true", help="print one frame and exit"
+    )
+    parser.add_argument(
+        "--frames",
+        type=int,
+        default=0,
+        help="stop after N frames (0 = until interrupted)",
+    )
+    args = parser.parse_args(argv)
+
+    from gelly_streaming_tpu.runtime.client import (
+        GellyClient,
+        _parse_addr,
+    )
+
+    host, port = _parse_addr(args.connect)
+    prev_edges: Optional[dict] = None
+    prev_t: Optional[float] = None
+    frames = 0
+    interactive = (
+        not args.once and sys.stdout.isatty()
+    )
+    with GellyClient(host, port, token=args.token) as client:
+        while True:
+            status = client.status()
+            snap = client.metrics()
+            now = time.monotonic()
+            dt = (now - prev_t) if prev_t is not None else None
+            lines = render_frame(status, snap, prev_edges, dt)
+            if interactive:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print("\n".join(lines), flush=True)
+            prev_edges = {
+                job_id: row.get("job_edges", 0)
+                for job_id, row in status.get("status", {})
+                .get("jobs", {})
+                .items()
+            }
+            prev_t = now
+            frames += 1
+            if args.once or (args.frames and frames >= args.frames):
+                return 0
+            time.sleep(max(0.1, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,10 +9,13 @@ optional jax.profiler trace context for device-level inspection.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
 from typing import List, Optional
+
+from gelly_streaming_tpu.utils.tracing import LatencyHistogram, nearest_rank
 
 
 class ThroughputMeter:
@@ -59,11 +62,36 @@ class ThroughputMeter:
         return edges / self.elapsed if self.elapsed > 0 else 0.0
 
 
-class WindowLatencyRecorder:
-    """Wall-clock latency from a window's close to its emitted result."""
+class _RecordingDeque(collections.deque):
+    """Bounded sample window that mirrors every append into a histogram —
+    keeps the old ``recorder.latencies_ms.append(...)`` call sites feeding
+    the bounded histogram without an API break."""
 
-    def __init__(self):
-        self.latencies_ms: List[float] = []
+    def __init__(self, histogram: LatencyHistogram, maxlen: int):
+        super().__init__(maxlen=maxlen)
+        self._histogram = histogram
+
+    def append(self, ms) -> None:
+        self._histogram.record(ms)
+        super().append(float(ms))
+
+
+class WindowLatencyRecorder:
+    """Wall-clock latency from a window's close to its emitted result.
+
+    Now a thin shim over the bounded machinery (utils/tracing.py): every
+    sample lands in a :class:`LatencyHistogram` (O(1) memory forever — the
+    fix for the unbounded list a long-lived ``gelly-serve --listen``
+    process grew without limit), and ``latencies_ms`` keeps the list-like
+    API as a bounded deque of the most recent ``max_samples`` raw values.
+    ``percentile`` uses proper nearest-rank math over those raw samples
+    (exact while nothing has been evicted); ``histogram`` holds the
+    all-time log-bucketed distribution.
+    """
+
+    def __init__(self, max_samples: int = 4096):
+        self.histogram = LatencyHistogram()
+        self.latencies_ms = _RecordingDeque(self.histogram, max_samples)
         self._open: Optional[float] = None
 
     def window_closed(self) -> None:
@@ -71,15 +99,18 @@ class WindowLatencyRecorder:
 
     def result_emitted(self) -> None:
         if self._open is not None:
-            self.latencies_ms.append((time.perf_counter() - self._open) * 1e3)
+            self.record((time.perf_counter() - self._open) * 1e3)
             self._open = None
 
+    def record(self, ms: float) -> None:
+        """Record one latency sample (histogram + bounded raw window)."""
+        self.latencies_ms.append(ms)
+
     def percentile(self, p: float) -> float:
-        if not self.latencies_ms:
-            return 0.0
-        xs = sorted(self.latencies_ms)
-        idx = min(int(len(xs) * p / 100.0), len(xs) - 1)
-        return xs[idx]
+        """Nearest-rank percentile of the retained raw samples (p50 of
+        [1, 2] is 1; p100 is the maximum, no clamp games — see
+        tracing.nearest_rank for the exact definition and the old bug)."""
+        return nearest_rank(sorted(self.latencies_ms), p)
 
     @property
     def p50_ms(self) -> float:
@@ -411,9 +442,15 @@ def drop_job_stats(job_id: str) -> None:
     """Forget one job's per-job registry row (the JobManager calls this
     when it evicts an old terminal job).  The module TOTALS keep the job's
     contribution — aggregates stay sums over every job ever run, only the
-    per-job breakdown is bounded."""
+    per-job breakdown is bounded.  The job's latency-histogram rows go
+    with it (the global-scope histograms keep its samples), so a
+    long-lived serving process's histogram registry is bounded by the
+    LIVE job set, not the job history."""
     with _JOB_LOCK:
         _JOB_COUNTERS.pop(job_id, None)
+    with _HIST_LOCK:
+        for key in [k for k in _HISTS if k[0] == "job" and k[1] == job_id]:
+            del _HISTS[key]
 
 
 def reset_job_stats() -> None:
@@ -518,6 +555,218 @@ def reset_tenant_stats() -> None:
     with _TENANT_LOCK:
         _TENANT_COUNTERS.clear()
         _TENANT_TOTALS = _tenant_zero()
+
+
+# ---------------------------------------------------------------------------
+# Bounded latency histograms (the observability plane, ISSUE 9).  Named
+# log-bucketed histograms registered per scope — process-global, per-job,
+# per-tenant — beside the counter registries above, replacing unbounded
+# sample lists.  The canonical names:
+#
+#   submit_to_first_emission_ms   job admission -> first record delivered
+#   window_close_to_emission_ms   merge-loop pane receipt -> record yield
+#   push_to_fold_ms               network ingest queue residency
+#   sched_queue_wait_ms           gap between a job's scheduler quanta
+#
+# Scoping rides a THREAD-LOCAL job tag: the scheduler wraps each job's
+# pulls in set_hist_job(), so histograms recorded deep inside the merge
+# loops land in that job's rows without the loops knowing about jobs.
+
+
+_HIST_LOCK = threading.Lock()
+# (kind, scope id, histogram name) -> LatencyHistogram; kind in
+# {"global", "job", "tenant"} with scope id "" for global
+_HISTS: dict = {}  # guarded-by: _HIST_LOCK
+
+_HIST_TL = threading.local()  # per-thread current-job tag (no lock needed)
+
+
+def set_hist_job(job_id: "str | None") -> "str | None":
+    """Tag this thread's subsequent ``hist_record`` calls with a job scope
+    (None clears it); returns the previous tag so callers can restore."""
+    old = getattr(_HIST_TL, "job", None)
+    _HIST_TL.job = job_id
+    return old
+
+
+def _hist(kind: str, scope: str, name: str) -> LatencyHistogram:
+    key = (kind, scope, name)
+    with _HIST_LOCK:
+        h = _HISTS.get(key)
+        if h is None:
+            h = _HISTS[key] = LatencyHistogram()
+        return h
+
+
+def hist_record(
+    name: str,
+    ms: float,
+    job: "str | None" = None,
+    tenant: "str | None" = None,
+    record_global: bool = True,
+) -> None:
+    """Record one latency sample into the global histogram plus the job
+    scope (explicit, or this thread's ``set_hist_job`` tag) and the tenant
+    scope when given.  Bounded state per scope; one lock per registry hit.
+
+    ``record_global=False`` records the scoped rows only — for a second
+    measurement point of a sample the global scope already counted (the
+    server sink's per-tenant submit-to-first stamp next to the
+    scheduler's per-job one), so global quantiles never double-count.
+    """
+    if record_global:
+        _hist("global", "", name).record(ms)
+    job = job if job is not None else getattr(_HIST_TL, "job", None)
+    if job:
+        _hist("job", job, name).record(ms)
+    if tenant:
+        _hist("tenant", tenant, name).record(ms)
+
+
+def hist_snapshot() -> dict:
+    """JSON-ready view of every registered histogram, grouped by scope:
+    ``{"global": {name: snap}, "jobs": {id: {name: snap}},
+    "tenants": {id: {name: snap}}}`` where each snap carries count, sum,
+    min/max, p50/p90/p99, and the non-empty buckets."""
+    with _HIST_LOCK:
+        items = list(_HISTS.items())
+    out: dict = {"global": {}, "jobs": {}, "tenants": {}}
+    for (kind, scope, name), h in items:
+        if kind == "global":
+            out["global"][name] = h.snapshot()
+        elif kind == "job":
+            out["jobs"].setdefault(scope, {})[name] = h.snapshot()
+        else:
+            out["tenants"].setdefault(scope, {})[name] = h.snapshot()
+    return out
+
+
+def job_latency_snapshot(job_id: str) -> dict:
+    """One job's histogram rows, compacted for status(): name ->
+    {count, p50_ms, p99_ms, max_ms}."""
+    with _HIST_LOCK:
+        items = [
+            (name, h)
+            for (kind, scope, name), h in _HISTS.items()
+            if kind == "job" and scope == job_id
+        ]
+    out = {}
+    for name, h in items:
+        snap = h.snapshot()
+        out[name] = {
+            "count": snap["count"],
+            "p50_ms": snap["p50_ms"],
+            "p99_ms": snap["p99_ms"],
+            "max_ms": snap["max_ms"],
+        }
+    return out
+
+
+def reset_histograms() -> None:
+    """Drop every registered histogram (call before a measurement
+    window, read ``hist_snapshot`` after)."""
+    with _HIST_LOCK:
+        _HISTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# exposition: one snapshot of every registry, plus a Prometheus renderer
+
+
+def metrics_snapshot() -> dict:
+    """The full observability registry as one JSON-ready dict: pipeline /
+    comms / wire counters, compile-cache stats, per-job and per-tenant
+    rows with their module totals, every latency histogram, and the
+    flight recorder's per-plane per-stage span aggregates.  This is what
+    the server's ``metrics`` verb returns and ``gelly-top`` polls."""
+    from gelly_streaming_tpu.utils import tracing
+
+    return {
+        "pipeline": pipeline_stats(),
+        "comms": comms_stats(),
+        "wire": wire_stats(),
+        "compile_cache": compile_cache_stats(),
+        "jobs": all_job_stats(),
+        "job_totals": job_totals(),
+        "tenants": all_tenant_stats(),
+        "tenant_totals": tenant_totals(),
+        "histograms": hist_snapshot(),
+        "spans": tracing.span_stats(),
+    }
+
+
+def _prom_escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _prom_sanitize(name: str) -> str:
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def render_prometheus(snap: Optional[dict] = None) -> str:
+    """Render a metrics snapshot in the Prometheus text exposition format
+    (``gelly_``-prefixed): flat counters as gauges, per-job/per-tenant
+    rows as labeled gauges, histograms as real Prometheus histograms
+    (cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``), and the span
+    stage aggregates as labeled totals."""
+    if snap is None:
+        snap = metrics_snapshot()
+    lines: List[str] = []
+
+    def gauge(name, value, labels=""):
+        lines.append(f"gelly_{_prom_sanitize(name)}{labels} {value}")
+
+    for section in ("pipeline", "comms", "wire", "compile_cache"):
+        for key, val in sorted(snap.get(section, {}).items()):
+            if isinstance(val, (int, float)):
+                gauge(key, val)
+    for scope_key, label in (("jobs", "job"), ("tenants", "tenant")):
+        for sid, row in sorted(snap.get(scope_key, {}).items()):
+            labels = f'{{{label}="{_prom_escape(sid)}"}}'
+            for key, val in sorted(row.items()):
+                if isinstance(val, (int, float)):
+                    gauge(key, val, labels)
+    hists = snap.get("histograms", {})
+    scoped = []
+    for name, h in hists.get("global", {}).items():
+        scoped.append((name, "", h))
+    for sid, row in hists.get("jobs", {}).items():
+        for name, h in row.items():
+            scoped.append((name, f'job="{_prom_escape(sid)}"', h))
+    for sid, row in hists.get("tenants", {}).items():
+        for name, h in row.items():
+            scoped.append((name, f'tenant="{_prom_escape(sid)}"', h))
+    ratio = 2.0 ** (1.0 / LatencyHistogram.PER_OCTAVE)
+    for name, label, h in scoped:
+        base = f"gelly_{_prom_sanitize(name)}"
+        cum = 0
+        for lower, count in h.get("buckets", []):
+            cum += count
+            sep = "," if label else ""
+            # le is the bucket's UPPER bound (snapshot stores lowers)
+            lines.append(
+                f'{base}_bucket{{{label}{sep}le="{round(lower * ratio, 6)}"}}'
+                f" {cum}"
+            )
+        sep = "," if label else ""
+        lines.append(f'{base}_bucket{{{label}{sep}le="+Inf"}} {h["count"]}')
+        braces = f"{{{label}}}" if label else ""
+        lines.append(f'{base}_sum{braces} {h["sum_ms"]}')
+        lines.append(f'{base}_count{braces} {h["count"]}')
+    for plane, stages in snap.get("spans", {}).get("stages", {}).items():
+        for stage, cell in sorted(stages.items()):
+            labels = (
+                f'{{plane="{_prom_escape(plane)}",'
+                f'stage="{_prom_escape(stage)}"}}'
+            )
+            gauge("span_stage_ms_total", cell["total_ms"], labels)
+            gauge("span_stage_count", cell["count"], labels)
+    return "\n".join(lines) + "\n"
 
 
 def compile_cache_stats() -> dict:

@@ -1,0 +1,45 @@
+"""Seeded bugs for the tracing fixtures (ISSUE 9): the flight recorder's
+'# guarded-by:' ring written without its lock (two racing drain threads
+interleave _next bumps and overwrite each other's slot — lost spans), and
+a blocking host sync smuggled into the traced dispatch hot loop (reading
+the span's fold result materializes the window inline, turning the
+overlapped pipeline back into per-window lockstep).
+
+Expected findings: one HOTSYNC, two UNGUARDED.  Analyzer input only —
+never imported.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+_CAP = 256
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = [None] * _CAP  # guarded-by: _lock
+        self._next = 0  # guarded-by: _lock
+
+    def record(self, span):
+        self._ring[self._next % _CAP] = span  # BUG: racing drains lose spans
+        self._next += 1  # BUG: lost-update window on the cursor
+
+
+def dispatch_loop(items, dispatch, recorder, sampler):
+    pending = []
+    # hot-loop: traced window dispatch (no per-window host syncs)
+    for meta, dev in items:
+        span = sampler.begin(meta) if sampler is not None else None
+        t0 = time.perf_counter()
+        handle = dispatch(meta, dev)
+        if span is not None:
+            # BUG: materializing the result to annotate the span blocks
+            # the dispatch loop on the device every sampled window
+            span.annotate(total=float(np.asarray(handle).sum()))
+            span.mark("dispatch", t0)
+        pending.append((span, handle))
+    # hot-loop-end
+    return pending

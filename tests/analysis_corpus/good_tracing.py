@@ -1,0 +1,53 @@
+"""Clean counterpart for the tracing fixtures (ISSUE 9): the flight
+recorder's ring is '# guarded-by:' its lock and every access holds it,
+and the dispatch hot loop's off-path tracing cost is a branch — no host
+syncs sneak in with the span marks.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+import time
+
+_CAP = 256
+
+
+class FlightRecorder:
+    """Fixed-capacity span ring: drain threads of many jobs record while
+    server threads read, so the ring state lives under one lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = [None] * _CAP  # guarded-by: _lock
+        self._next = 0  # guarded-by: _lock
+
+    def record(self, span):
+        with self._lock:
+            self._ring[self._next % _CAP] = span
+            self._next += 1
+
+    def last(self, n):
+        with self._lock:
+            end = self._next
+            return [
+                self._ring[i % _CAP] for i in range(max(0, end - n), end)
+            ]
+
+
+def dispatch_loop(items, dispatch, recorder, sampler):
+    """The instrumented dispatch loop: sampling off = one branch per
+    window; sampled windows mark stages with clock reads only."""
+    pending = []
+    # hot-loop: traced window dispatch (no per-window host syncs)
+    for meta, dev in items:
+        span = sampler.begin(meta) if sampler is not None else None
+        t0 = time.perf_counter()
+        handle = dispatch(meta, dev)
+        if span is not None:
+            span.mark("dispatch", t0)
+        pending.append((span, handle))
+    # hot-loop-end
+    for span, handle in pending:
+        if span is not None:
+            recorder.record(span)
+    return pending

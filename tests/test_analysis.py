@@ -84,6 +84,7 @@ def test_cli_package_scan_exits_zero():
             "ops",
             "parallel",
             "runtime",
+            "utils",
         ],
         capture_output=True,
         text=True,
@@ -216,6 +217,23 @@ def test_corpus_wirebin():
     assert _codes(findings) == ["HOTSYNC", "UNGUARDED"]
     assert any("_WIRE_BYTES" in f.message for f in findings)
     assert _analyze("good_wirebin.py") == []
+
+
+def test_corpus_tracing():
+    """The observability fixtures (ISSUE 9): the flight recorder's span
+    ring is '# guarded-by:' its lock (drain threads of many jobs write
+    while status/server threads read), and the traced dispatch loop stays
+    a '# hot-loop' region — span marks are clock reads, never host syncs."""
+    findings = _analyze("bad_tracing.py")
+    assert _codes(findings) == [
+        "HOTSYNC",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+    ]
+    assert any("self._ring" in f.message for f in findings)
+    assert any("self._next" in f.message for f in findings)
+    assert _analyze("good_tracing.py") == []
 
 
 def test_corpus_collgather():

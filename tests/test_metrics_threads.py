@@ -141,3 +141,60 @@ def test_pipeline_high_water_is_max_under_contention():
         assert stats["pipeline_inflight_high_water"] == THREADS * ITERS - 1
     finally:
         metrics.reset_pipeline_stats()
+
+
+@pytest.mark.timeout_cap(120)
+def test_histogram_registry_no_lost_updates_no_cross_scope_bleed():
+    """The bounded latency histograms (ISSUE 9): THREADS workers record
+    into TWO job scopes concurrently — every sample lands exactly once in
+    its own job's histogram AND the global one (no lost bucket bumps, no
+    bleed between scopes)."""
+    metrics.reset_histograms()
+    try:
+        flip = [0]
+        flip_lock = threading.Lock()
+
+        def bump():
+            with flip_lock:
+                flip[0] += 1
+                jid = "hist-a" if flip[0] % 2 else "hist-b"
+            metrics.hist_record("window_close_to_emission_ms", 1.0, job=jid)
+
+        _hammer(bump)
+        total = THREADS * ITERS
+        snap = metrics.hist_snapshot()
+        a = snap["jobs"]["hist-a"]["window_close_to_emission_ms"]["count"]
+        b = snap["jobs"]["hist-b"]["window_close_to_emission_ms"]["count"]
+        assert a + b == total
+        assert a == total // 2 + (total % 2)
+        assert b == total // 2
+        assert (
+            snap["global"]["window_close_to_emission_ms"]["count"] == total
+        )
+    finally:
+        metrics.reset_histograms()
+
+
+@pytest.mark.timeout_cap(120)
+def test_flight_recorder_ring_no_lost_records():
+    """The span ring (ISSUE 9): THREADS drain threads record spans into
+    one fixed-capacity ring concurrently — the recorded count is exact
+    (no lost slot writes under the '# guarded-by:' lock), the ring holds
+    exactly its capacity, and the stage aggregates saw every span."""
+    from gelly_streaming_tpu.utils import tracing
+
+    rec = tracing.FlightRecorder(capacity=64)
+
+    def bump():
+        span = tracing.WindowSpan(1, "hammer", 0)
+        span.mark("dispatch", span.t0)
+        rec.record(span)
+
+    _hammer(bump)
+    total = THREADS * ITERS
+    stats = rec.stats()
+    assert stats["recorded"] == total
+    assert stats["held"] == 64
+    assert len(rec.last(1000)) == 64
+    assert stats["stages"]["hammer"]["total"]["count"] == total
+    assert stats["stages"]["hammer"]["dispatch"]["count"] == total

@@ -835,3 +835,190 @@ def test_client_deadline_fails_loudly_not_forever():
                     "idle", poll_timeout_ms=100, deadline_s=1.0
                 ):
                     pass
+
+
+# ---------------------------------------------------------------------------
+# observability plane (ISSUE 9): metrics / trace verbs, FAILED post-mortems,
+# gelly-top
+
+
+def _push_one_job(server, name, seed=0, trace_sample=0.0, token=""):
+    s, d = _graph(seed)
+    with GellyClient("127.0.0.1", server.port, token=token) as c:
+        spec = dict(
+            name=name, query="cc", capacity=CAP, window_edges=W, batch=B
+        )
+        if trace_sample:
+            spec["trace_sample"] = trace_sample
+        c.submit(**spec)
+        c.push_edges(name, s, d, batch=B, capacity=CAP)
+        return list(c.iter_results(name, deadline_s=240))
+
+
+def test_metrics_verb_returns_histograms_and_prometheus():
+    metrics.reset_histograms()
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as server:
+        recs = _push_one_job(server, "obs")
+        assert recs
+        with GellyClient("127.0.0.1", server.port) as c:
+            snap = c.metrics()
+            # the four canonical histograms all saw this job
+            job_rows = snap["histograms"]["jobs"]["default/obs"]
+            for name in (
+                "submit_to_first_emission_ms",
+                "window_close_to_emission_ms",
+                "push_to_fold_ms",
+                "sched_queue_wait_ms",
+            ):
+                assert job_rows[name]["count"] > 0, name
+                assert job_rows[name]["p99_ms"] >= job_rows[name]["p50_ms"]
+            # per-tenant submit-to-first row (stamped at the server sink)
+            t_row = snap["histograms"]["tenants"]["default"]
+            assert t_row["submit_to_first_emission_ms"]["count"] == 1
+            # process planes ride along
+            assert snap["pipeline"]["pipeline_windows_drained"] >= 0
+            assert "recompiles" in snap["compile_cache"]
+            # prometheus text renders the same registry
+            text = c.metrics_prometheus()
+            assert 'gelly_job_records{job="default/obs"}' in text
+            assert "gelly_submit_to_first_emission_ms_count" in text
+            assert 'le="+Inf"' in text
+
+
+def test_metrics_verb_is_tenant_scoped():
+    cfg = ServerConfig(
+        tenants=(
+            TenantConfig(tenant="a", token="tok-a"),
+            TenantConfig(tenant="b", token="tok-b"),
+        )
+    )
+    metrics.reset_histograms()
+    with JobManager() as jm, StreamServer(jm, cfg) as server:
+        _push_one_job(server, "mine", token="tok-a")
+        with GellyClient("127.0.0.1", server.port, token="tok-b") as c:
+            snap = c.metrics()
+            # tenant b sees none of tenant a's jobs, rows, or histograms
+            assert snap["jobs"] == {}
+            assert snap["job_totals"] == {}
+            assert list(snap["tenants"]) == ["b"]
+            assert snap["histograms"]["jobs"] == {}
+            assert snap["histograms"]["tenants"] == {}
+        with GellyClient("127.0.0.1", server.port, token="tok-a") as c:
+            snap = c.metrics()
+            assert "a/mine" in snap["jobs"]
+            assert "a/mine" in snap["histograms"]["jobs"]
+
+
+def test_trace_verb_dumps_sampled_spans():
+    from gelly_streaming_tpu.utils import tracing
+
+    tracing.reset_tracing()
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as server:
+        recs = _push_one_job(server, "traced", trace_sample=1.0)
+        with GellyClient("127.0.0.1", server.port) as c:
+            reply = c.trace(64)
+            assert reply["tracing_active"]
+            spans = reply["spans"]
+            assert len(spans) >= len(recs)
+            stages = {s["stage"] for s in spans[-1]["stages"]}
+            assert "dispatch" in stages and "queued" in stages
+            # the per-stage aggregates the metrics verb exposes: stage sums
+            # equal the total wall clock (the queued residual closes the
+            # gap by construction)
+            agg = c.metrics()["spans"]["stages"]
+            plane = next(iter(agg.values()))
+            attributed = sum(
+                v["total_ms"] for k, v in plane.items() if k != "total"
+            )
+            assert attributed == pytest.approx(
+                plane["total"]["total_ms"], rel=0.10
+            )
+    tracing.reset_tracing()
+
+
+def test_failed_job_status_carries_flight_recorder_dump():
+    from gelly_streaming_tpu.utils import tracing
+
+    # tracing must be ACTIVE for the dump (a process that never traced
+    # has nothing to dump); activate it and seed one span
+    tracing.sampler(StreamConfig(trace_sample=1.0), "seed")
+    span = tracing.WindowSpan(999_999, "seed", 7)
+    tracing.flight_recorder().record(span)
+
+    def bad_build():
+        def it():
+            yield (np.zeros(4),)
+            raise RuntimeError("kaboom")
+
+        return it()
+
+    with JobManager() as jm:
+        job = jm.submit(bad_build, name="doomed")
+        job.wait(60)
+        assert job.state == JobState.FAILED
+        row = jm.status()["jobs"]["doomed"]
+        assert row["error"] is not None
+        assert isinstance(row["trace"], list) and row["trace"]
+        assert any(s["trace_id"] == 999_999 for s in row["trace"])
+    tracing.reset_tracing()
+
+
+def test_gelly_top_once_renders_live_server(capsys):
+    from gelly_streaming_tpu.runtime import top as top_mod
+
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as server:
+        _push_one_job(server, "topjob")
+        rc = top_mod.main(
+            ["--connect", f"127.0.0.1:{server.port}", "--once"]
+        )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gelly-top" in out
+    assert "default/topjob" in out
+    assert "DONE" in out
+    assert "TENANT" in out and "default" in out
+
+
+def test_gelly_top_render_frame_computes_eps():
+    from gelly_streaming_tpu.runtime.top import render_frame
+
+    status = {
+        "server": {"connections": 1, "served_jobs": 1, "port": 1234},
+        "status": {
+            "jobs": {
+                "t/j": {
+                    "state": "RUNNING",
+                    "job_records": 10,
+                    "job_edges": 20_000,
+                    "queue_depth": 2,
+                }
+            }
+        },
+    }
+    snap = {
+        "pipeline": {},
+        "spans": {},
+        "tenants": {},
+        "histograms": {
+            "jobs": {
+                "t/j": {
+                    "window_close_to_emission_ms": {
+                        "count": 10,
+                        "p50_ms": 1.5,
+                        "p99_ms": 9.0,
+                    },
+                    "submit_to_first_emission_ms": {
+                        "count": 1,
+                        "p50_ms": 42.0,
+                        "p99_ms": 42.0,
+                    },
+                }
+            }
+        },
+    }
+    lines = render_frame(status, snap, {"t/j": 10_000}, 2.0)
+    row = next(l for l in lines if l.startswith("t/j"))
+    assert "RUNNING" in row
+    assert "5.0k" in row  # (20000 - 10000) / 2.0 s
+    assert "1.5/9.0" in row
+    assert "42.0" in row
