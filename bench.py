@@ -719,6 +719,145 @@ def _serving_bench(
 _PARTIAL = {}  # best results so far, emitted by the deadline watchdog
 
 
+# ---------------------------------------------------------------------------
+# --check-regression: compare a fresh bench JSON against the best-so-far
+# per key across the recorded BENCH_r*.json artifacts (ISSUE 10).  CI's
+# keep-up check for the bench itself: a fresh run whose tracked keys fall
+# beyond tolerance of the historical best exits nonzero with a per-key
+# verdict table.  _PARTIAL-safe by construction — keys missing from the
+# fresh run (device_unavailable partials) or from every baseline are
+# SKIP/NEW, never failures, so a tunnel outage still checks the host-side
+# numbers it did record.
+
+# direction rules by suffix/name: "higher" keys regress downward, "lower"
+# keys regress upward; anything unclassified (or non-scalar) is skipped
+_HIGHER_KEYS = {"value", "value_wall", "vs_baseline", "vs_baseline_wall"}
+_HIGHER_SUFFIXES = (
+    "_eps",
+    "_speedup",
+    "_gbps",
+    "_ratio",
+    "_spread",
+    "_util_lower_bound",
+)
+_LOWER_SUFFIXES = ("_ms", "_bytes_per_edge", "_spilled", "_findings")
+_LOWER_SUBSTRINGS = ("recompiles", "_stall_s")
+
+
+def _bench_direction(key):
+    """'higher' / 'lower' / None (= not a tracked perf key)."""
+    if key in _HIGHER_KEYS or key.endswith(_HIGHER_SUFFIXES):
+        return "higher"
+    if key.endswith(_LOWER_SUFFIXES) or any(
+        s in key for s in _LOWER_SUBSTRINGS
+    ):
+        return "lower"
+    return None
+
+
+def _load_bench_json(path):
+    """A bench artifact's metric dict: either the raw JSON line main()
+    prints, or the driver wrapper whose ``parsed`` key holds it."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    return doc if isinstance(doc, dict) else {}
+
+
+def _bench_scalars(doc):
+    return {
+        k: float(v)
+        for k, v in doc.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+
+
+def check_regression(fresh_path, baseline_glob="BENCH_r*.json", tolerance=0.05):
+    """Per-key verdicts of ``fresh_path`` vs the best-so-far baselines.
+
+    Returns the process exit code: 1 iff any tracked key regressed beyond
+    ``tolerance`` (relative; absolute for a 0 lower-better best, so a
+    recompile count creeping off 0 is caught).
+    """
+    import glob as _glob
+
+    fresh = _bench_scalars(_load_bench_json(fresh_path))
+    best = {}
+    baselines = sorted(_glob.glob(baseline_glob))
+    for path in baselines:
+        try:
+            scalars = _bench_scalars(_load_bench_json(path))
+        except (OSError, ValueError):
+            continue  # a torn/partial artifact is skipped, never fatal
+        for key, val in scalars.items():
+            direction = _bench_direction(key)
+            if direction is None:
+                continue
+            if key not in best:
+                best[key] = val
+            elif direction == "higher":
+                best[key] = max(best[key], val)
+            else:
+                best[key] = min(best[key], val)
+    rows = []
+    failed = 0
+    for key in sorted(set(best) | set(fresh)):
+        direction = _bench_direction(key)
+        if direction is None:
+            continue
+        b, f = best.get(key), fresh.get(key)
+        if f is None:
+            verdict = "SKIP (missing in fresh — partial run)"
+        elif b is None:
+            verdict = "NEW (no baseline)"
+        elif direction == "higher":
+            verdict = "REGRESS" if f < b * (1.0 - tolerance) else "OK"
+        elif b == 0:
+            verdict = "REGRESS" if f > tolerance else "OK"
+        else:
+            verdict = "REGRESS" if f > b * (1.0 + tolerance) else "OK"
+        failed += verdict == "REGRESS"
+        rows.append((key, direction, b, f, verdict))
+    width = max([len(r[0]) for r in rows], default=10)
+
+    def fmt(x):
+        return "-" if x is None else f"{x:.4g}"
+
+    print(
+        f"{'key':<{width}}  {'dir':<6} {'best':>12} {'fresh':>12}  verdict"
+    )
+    for key, direction, b, f, verdict in rows:
+        print(
+            f"{key:<{width}}  {direction:<6} {fmt(b):>12} {fmt(f):>12}  "
+            f"{verdict}"
+        )
+    print(
+        f"check-regression: {len(rows)} tracked key(s) vs "
+        f"{len(baselines)} baseline artifact(s), tolerance "
+        f"{tolerance:.0%}, {failed} regression(s)"
+    )
+    return 1 if failed else 0
+
+
+def _check_regression_cli(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py --check-regression",
+        description="compare a fresh bench JSON against the best-so-far "
+        "per key across BENCH_r*.json; exit 1 on regression",
+    )
+    parser.add_argument("--check-regression", dest="fresh", required=True,
+                        metavar="FRESH_JSON")
+    parser.add_argument("--tolerance", type=float, default=0.05,
+                        help="relative slack before a key regresses")
+    parser.add_argument("--glob", default="BENCH_r*.json",
+                        help="baseline artifact glob")
+    args = parser.parse_args(argv)
+    return check_regression(args.fresh, args.glob, args.tolerance)
+
+
 def _link_regime(chunk_gbps):
     """Classify a drive's achieved wire rates against the tunnel model.
 
@@ -1899,4 +2038,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if any(a.startswith("--check-regression") for a in sys.argv[1:]):
+        sys.exit(_check_regression_cli(sys.argv[1:]))
     main()

@@ -9,6 +9,7 @@ carries the capacity/mesh/window knobs that static XLA shapes require.
 from __future__ import annotations
 
 import dataclasses
+import re
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,6 +201,115 @@ class StreamConfig:
 DEFAULT_CONFIG = StreamConfig()
 
 
+# SLO gauge-metric vocabulary: spec metric name -> (health gauge key,
+# violation direction).  "gt" = a sample above the threshold is bad (lag,
+# backlog); "lt" = below is bad (keep-up ratio).  Gauge metrics read the
+# per-job health rows (utils.metrics.all_job_health), so they are job-scope
+# only; histogram metrics (``p99_window_close_to_emission_ms`` style) work
+# at job, tenant, and global scope.
+SLO_GAUGE_METRICS = {
+    "max_backlog_age_s": ("backlog_age_s", "gt"),
+    "max_backlog_batches": ("backlog_batches", "gt"),
+    "max_watermark_lag_windows": ("watermark_lag_windows", "gt"),
+    "min_keepup_ratio": ("keepup_ratio", "lt"),
+}
+
+# pNN_<histogram name>: the quantile prefix both names the intent and
+# fixes the error budget (p99 <= T  ==  at most 1% of samples over T)
+_SLO_HIST_RE = re.compile(r"^p(\d{1,2}(?:\.\d+)?)_([a-z0-9_]+_ms)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One declarative service-level objective (evaluated by
+    runtime/slo.py's monitor against the existing histograms/gauges).
+
+    The metric grammar:
+
+    * ``p99_window_close_to_emission_ms`` (any ``pNN_<histogram>_ms``) —
+      "NN% of samples of that latency histogram stay under ``threshold``
+      ms".  The quantile prefix derives the error budget (p99 -> 1% of
+      samples may exceed), unless ``error_budget`` overrides it.
+    * a :data:`SLO_GAUGE_METRICS` name (``max_backlog_age_s``,
+      ``min_keepup_ratio``, ...) — "the job's gauge stays on the right
+      side of ``threshold``".  Each monitor tick samples the gauge; the
+      budget is the tolerated fraction of violating ticks (default 0.1).
+
+    Alerting follows the SRE multiwindow burn-rate pattern: the bad-sample
+    fraction over a FAST and a SLOW window, each divided by the budget, is
+    the burn rate; WARN needs both windows at ``warn_burn``+, PAGE both at
+    ``page_burn``+ (the fast window makes alerts responsive, the slow one
+    keeps a brief blip from paging).  De-escalation is hysteretic: one
+    level down per ``clear_hold`` consecutive below-warn evaluations, so a
+    flapping metric cannot oscillate OK<->PAGE at tick rate.
+
+    ``scope`` picks the registry ("job"/"tenant"/"global") and ``target``
+    is an fnmatch pattern over instance ids (server jobs are
+    ``tenant/name``), so one spec fans out over every matching live job.
+    """
+
+    metric: str
+    threshold: float
+    scope: str = "job"
+    target: str = "*"
+    name: str = ""
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    warn_burn: float = 1.0
+    page_burn: float = 4.0
+    error_budget: float = 0.0  # 0 = derive (pNN prefix, or 0.1 for gauges)
+    clear_hold: int = 3
+
+    def __post_init__(self):
+        if self.scope not in ("job", "tenant", "global"):
+            raise ValueError("SLO scope must be job/tenant/global")
+        if self.threshold <= 0:
+            raise ValueError("SLO threshold must be positive")
+        if not (0 < self.fast_window_s < self.slow_window_s):
+            raise ValueError(
+                "SLO windows need 0 < fast_window_s < slow_window_s"
+            )
+        if not (0 < self.warn_burn <= self.page_burn):
+            raise ValueError("SLO burns need 0 < warn_burn <= page_burn")
+        if not (0.0 <= self.error_budget < 1.0):
+            raise ValueError("error_budget must be in [0, 1)")
+        if self.clear_hold < 1:
+            raise ValueError("clear_hold must be >= 1 evaluation")
+        if self.metric in SLO_GAUGE_METRICS:
+            if self.scope != "job":
+                raise ValueError(
+                    f"gauge SLO metric {self.metric!r} is job-scope only "
+                    "(gauges live in the per-job health rows)"
+                )
+        elif not _SLO_HIST_RE.match(self.metric):
+            raise ValueError(
+                f"unknown SLO metric {self.metric!r}: expected a "
+                f"pNN_<histogram>_ms quantile objective or one of "
+                f"{sorted(SLO_GAUGE_METRICS)}"
+            )
+
+    def kind(self) -> tuple:
+        """("hist", histogram name, quantile) or ("gauge", key, cmp)."""
+        gauge = SLO_GAUGE_METRICS.get(self.metric)
+        if gauge is not None:
+            return ("gauge",) + gauge
+        m = _SLO_HIST_RE.match(self.metric)
+        return ("hist", m.group(2), float(m.group(1)))
+
+    def budget(self) -> float:
+        """Effective error budget (explicit wins; else pNN-derived for
+        histogram objectives, 0.1 of ticks for gauge objectives)."""
+        if self.error_budget > 0:
+            return self.error_budget
+        kind = self.kind()
+        if kind[0] == "hist":
+            return max(1.0 - kind[2] / 100.0, 1e-4)
+        return 0.1
+
+    def alert_name(self) -> str:
+        return self.name or self.metric
+
+
 @dataclasses.dataclass(frozen=True)
 class RuntimeConfig:
     """Knobs for the multi-tenant job runtime (runtime/manager.py).
@@ -230,6 +340,18 @@ class RuntimeConfig:
         ``status()`` history.  Older terminal jobs are evicted at the next
         submit (their source closures were already dropped at the terminal
         transition), bounding a long-lived serving process's footprint.
+      health_sample_s: interval at which the scheduler loop samples each
+        live job's keep-up gauges (watermark lag, backlog depth/age, EWMA
+        arrival vs drain rates) into utils.metrics' health registry.  The
+        sampler reads host-side Python counters only — never a device
+        sync — so the default-on 1 Hz costs one clock check per scheduler
+        round.  0 disables sampling entirely.
+      slos: declarative :class:`SLOSpec` objectives.  Non-empty starts the
+        burn-rate monitor thread (runtime/slo.py) alongside the scheduler;
+        the empty default costs nothing — no thread, no branch in the
+        data planes.
+      slo_interval_s: seconds between SLO monitor evaluations (each one
+        reads histogram/gauge registries and updates the alert rows).
     """
 
     max_jobs: int = 8
@@ -237,6 +359,9 @@ class RuntimeConfig:
     job_queue_depth: int = 64
     fair_quantum: int = 4
     keep_terminal_jobs: int = 64
+    health_sample_s: float = 1.0
+    slos: tuple = ()
+    slo_interval_s: float = 0.5
 
     def __post_init__(self):
         if self.max_jobs <= 0:
@@ -249,6 +374,12 @@ class RuntimeConfig:
             raise ValueError("fair_quantum must be positive")
         if self.keep_terminal_jobs < 0:
             raise ValueError("keep_terminal_jobs must be >= 0")
+        if self.health_sample_s < 0:
+            raise ValueError("health_sample_s must be >= 0 (0 = off)")
+        if self.slo_interval_s <= 0:
+            raise ValueError("slo_interval_s must be positive")
+        if not all(isinstance(s, SLOSpec) for s in self.slos):
+            raise ValueError("slos must be a tuple of SLOSpec")
 
 
 @dataclasses.dataclass(frozen=True)

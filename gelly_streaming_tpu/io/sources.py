@@ -415,6 +415,56 @@ class NetworkEdgeSource:
         """Current ingest-queue occupancy (approximate, lock-free)."""
         return self._q.qsize()
 
+    def progress(self) -> dict:
+        """The health plane's progress probe (ISSUE 10): one consistent-
+        enough snapshot of the source's positional accounting for the
+        scheduler's gauge sampler (runtime/manager.py _sample_health).
+
+        * ``backlog_age_s`` rides the enqueue timestamps the queue tuples
+          already carry for the push-to-fold histogram — the OLDEST one
+          is how long this job has not been keeping up (a depth gauge
+          alone can't distinguish a 100 ms blip from a wedged minute).
+        * ``closable_windows`` / ``delivered_windows`` are exactly
+          ``ready()``'s accounting, surfaced: their gap is the job's
+          watermark lag in ingest windows.
+
+        Pure host counter reads under the two existing locks (taken in
+        sequence, never nested) — called at the health sample rate, not
+        per push or per pull, so it adds nothing to either hot path.
+        """
+        now = time.perf_counter()
+        with self._q.mutex:  # qsize()'s own lock; peek needs it too
+            depth = len(self._q.queue)
+            oldest_t = self._q.queue[0][2] if depth else None
+            cap_batches = self._q.maxsize
+        with self._lock:
+            edges_in = self._edges_in
+            edges_out = self._edges_out
+        w = self.cfg.ingest_window_edges
+        closable = (edges_in - 1) // w if (w and edges_in) else 0
+        delivered = (edges_out - 1) // w if (w and edges_out) else 0
+        if w:
+            # the same resume floor ready() applies: the checkpoint-covered
+            # filler region counts as delivered (those windows replay-skip,
+            # they are not lag) — without it every restore would page a
+            # watermark-lag SLO until the client streamed past the cursor
+            delivered = max(delivered, self._resume_edges // w)
+        # age counts only while a closable window sits undelivered: a tail
+        # batch the pane cutter is HOLDING for its window to fill is the
+        # stream trickling, not the job falling behind — ageing it would
+        # page on every live stream's boundary-straddling remainder
+        lagging = closable > delivered and oldest_t is not None
+        return {
+            "edges_in": edges_in,
+            "edges_out": edges_out,
+            "backlog_batches": depth,
+            "backlog_edges": depth * self.batch,
+            "backlog_age_s": (now - oldest_t) if lagging else 0.0,
+            "queue_capacity_edges": cap_batches * self.batch,
+            "closable_windows": closable,
+            "delivered_windows": delivered,
+        }
+
     @property
     def edges_accepted(self) -> int:
         """Total edges accepted, resume filler included."""

@@ -272,6 +272,25 @@ class GellyClient:
         )
         return payload.decode("utf-8")
 
+    def health(self) -> dict:
+        """The health plane's keep-up verdicts: per-job gauges (watermark
+        lag, backlog depth/age, arrival/drain EWMA rates, keep-up ratio,
+        time-to-queue-full), visible alert rows, configured SLO specs, and
+        the monitor's liveness stats."""
+        return self.call({"verb": "health"})[0]["health"]
+
+    def alerts(self) -> list:
+        """Just the visible SLO alert rows (state, burn rates, since)."""
+        return self.call({"verb": "alerts"})[0]["alerts"]
+
+    def events(self, n: int = 64, kind: "Optional[str]" = None) -> list:
+        """Tail the structured event journal (job transitions, admission
+        rejections, drain/restart cursors, alert firings/clears)."""
+        header: dict = {"verb": "events", "n": n}
+        if kind is not None:
+            header["kind"] = kind
+        return self.call(header)[0]["events"]
+
     def trace(self, n: int = 32) -> dict:
         """The flight recorder's last ``n`` window spans plus the span
         stage aggregates: ``{"spans": [...], "tracing_active": bool,
@@ -377,6 +396,20 @@ def main(argv=None) -> int:
     )
     p_trace.add_argument("--last", type=int, default=32)
 
+    sub.add_parser(
+        "health",
+        help="per-job keep-up gauges (lag, backlog age, keep-up ratio) "
+        "and SLO alert states",
+    )
+
+    p_events = sub.add_parser(
+        "events",
+        help="tail the structured event journal (lifecycle transitions, "
+        "admission rejections, cursors, alert firings/clears)",
+    )
+    p_events.add_argument("--last", type=int, default=64)
+    p_events.add_argument("--kind", default=None)
+
     p_cancel = sub.add_parser("cancel", help="cancel a job")
     p_cancel.add_argument("--job", required=True)
 
@@ -478,6 +511,29 @@ def _run_cmd(client: GellyClient, args) -> int:
                 f"#{span['trace_id']} {span['plane']} w={span['window']} "
                 f"total={span['total_ms']:.2f}ms  {stages}"
             )
+        return 0
+    if args.cmd == "health":
+        health = client.health()
+        for job_id, row in sorted(health["jobs"].items()):
+            gauges = " ".join(
+                f"{k}={v}" for k, v in sorted(row.items())
+            )
+            print(f"{job_id}: {gauges}")
+        for a in health["alerts"]:
+            print(
+                f"alert [{a['state']}] {a['scope']}:{a['id']} {a['slo']} "
+                f"burn_fast={a['burn_fast']} burn_slow={a['burn_slow']}"
+            )
+        mon = health.get("monitor")
+        print(
+            f"monitor: {mon}" if mon else "monitor: off (no SLOs configured)"
+        )
+        return 0
+    if args.cmd == "events":
+        import json as _json
+
+        for ev in client.events(args.last, kind=args.kind):
+            print(_json.dumps(ev, sort_keys=True))
         return 0
     if args.cmd == "cancel":
         reply = client.cancel(args.job)

@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Any, Callable, Iterator, List, Optional
 
+from gelly_streaming_tpu.utils import events
+
 
 class JobState:
     """Lifecycle states (string constants so status() serializes as-is)."""
@@ -121,6 +123,7 @@ class Job:
         edges_hint: Optional[int] = None,
         queue_depth: int = 64,
         ready: Optional[Callable[[], bool]] = None,
+        progress: Optional[Callable[[], dict]] = None,
     ):
         if weight <= 0:
             raise ValueError("job weight must be positive")
@@ -144,6 +147,12 @@ class Job:
         # scheduler thread on a slow or dead producer.  Must be thread-safe
         # and non-blocking; None = always runnable (the historical default).
         self._ready = ready
+        # health-plane probe (ISSUE 10): a thread-safe, non-blocking
+        # callable returning the source's progress dict (edges in/out,
+        # backlog depth/age, closable vs delivered windows — see
+        # NetworkEdgeSource.progress).  Sampled by the scheduler loop at
+        # the health rate; None = gauge row limited to sink-side figures.
+        self._progress = progress
         self._lock = manager_lock  # the MANAGER's lock, shared by reference
         self._state = JobState.PENDING  # guarded-by: _lock
         self._error: Optional[BaseException] = None  # guarded-by: _lock
@@ -242,16 +251,28 @@ class Job:
 
     def _transition(self, new_state: str) -> None:
         """Move the state machine; caller MUST hold the manager lock (the
-        re-entrant acquisition here is the analyzer-visible guard)."""
+        re-entrant acquisition here is the analyzer-visible guard).
+
+        Every legal transition lands in the structured event journal
+        (utils/events.py) — the journal lock is a leaf lock, so emitting
+        under the manager lock cannot deadlock — which is what makes a
+        job's full lifecycle replayable post-mortem instead of
+        reconstructed from span guesses.
+        """
         with self._lock:
             if (self._state, new_state) not in _ALLOWED:
                 raise RuntimeError(
                     f"job {self.job_id!r}: illegal transition "
                     f"{self._state} -> {new_state}"
                 )
+            old = self._state
             self._state = new_state
             if new_state in JobState.TERMINAL:
                 self._done_evt.set()
+            fields = {"job": self.job_id, "from": old, "to": new_state}
+            if new_state == JobState.FAILED and self._error is not None:
+                fields["error"] = repr(self._error)
+            events.journal().emit("job_transition", **fields)
 
     def _state_in(self, *states: str) -> bool:
         with self._lock:

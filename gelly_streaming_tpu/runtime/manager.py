@@ -45,7 +45,7 @@ from gelly_streaming_tpu.runtime.job import (
     Job,
     JobState,
 )
-from gelly_streaming_tpu.utils import metrics, tracing
+from gelly_streaming_tpu.utils import events, metrics, tracing
 
 
 class JobManager:
@@ -66,6 +66,15 @@ class JobManager:
         # scheduler parks on this when no job can make progress; submits,
         # resumes, cancels, and consumer gets wake it
         self._wake = threading.Event()
+        # health-plane sampling (ISSUE 10): the scheduler loop samples each
+        # live job's keep-up gauges every health_sample_s seconds — all
+        # state below is touched by the scheduler thread only
+        self._health_every = float(self.cfg.health_sample_s or 0.0)
+        self._next_health = 0.0  # single-thread: scheduler
+        self._keepup: Dict[str, metrics.KeepUpTracker] = {}  # single-thread: scheduler
+        # SLO burn-rate monitor (runtime/slo.py): started with the
+        # scheduler when cfg.slos is non-empty, stopped at shutdown
+        self._slo_monitor = None  # guarded-by: _lock
 
     # -- submission ----------------------------------------------------------
 
@@ -81,6 +90,7 @@ class JobManager:
         edges_per_record: int = 0,
         edges_hint: Optional[int] = None,
         ready: Optional[Callable[[], bool]] = None,
+        progress: Optional[Callable[[], dict]] = None,
     ) -> Job:
         """Admit a query whose ``build()`` returns a fresh records iterator
         (the ``OutputStream`` contract: ``iter(stream.aggregate(...))``).
@@ -97,6 +107,11 @@ class JobManager:
         round (counted as ``job_source_wait_skips``) so a starved source
         idles its own job, never the scheduler.  Producers should ``poke()``
         the manager after feeding the source.
+
+        ``progress`` (optional, same thread-safety contract as ``ready``):
+        a probe returning the source's progress dict (see
+        ``NetworkEdgeSource.progress``) for the health plane's keep-up
+        gauges; jobs without one still get sink-side gauges.
         """
         state_bytes = int(state_bytes)
         with self._lock:
@@ -108,19 +123,21 @@ class JobManager:
                 if not j._state_in(*JobState.TERMINAL)
             ]
             if len(active) >= self.cfg.max_jobs:
-                raise AdmissionError(
+                self._reject(
+                    name,
                     f"job cap reached: {len(active)} active jobs >= "
-                    f"max_jobs={self.cfg.max_jobs}"
+                    f"max_jobs={self.cfg.max_jobs}",
                 )
             if (
                 self.cfg.max_state_bytes
                 and self._admitted_bytes + state_bytes
                 > self.cfg.max_state_bytes
             ):
-                raise AdmissionError(
+                self._reject(
+                    name,
                     f"state-byte cap reached: {self._admitted_bytes} admitted"
                     f" + {state_bytes} requested > "
-                    f"max_state_bytes={self.cfg.max_state_bytes}"
+                    f"max_state_bytes={self.cfg.max_state_bytes}",
                 )
             if checkpoint_path is not None and any(
                 j.checkpoint_path == checkpoint_path
@@ -129,16 +146,17 @@ class JobManager:
                 # two live jobs interleaving saves into ONE snapshot file
                 # would corrupt both resumes; derive per-job files from a
                 # shared prefix with utils.checkpoint.per_job_file instead
-                raise AdmissionError(
+                self._reject(
+                    name,
                     f"checkpoint path {checkpoint_path!r} is already in use "
                     "by an active job (use checkpoint.per_job_file to key a "
-                    "shared prefix per job)"
+                    "shared prefix per job)",
                 )
             job_id = name or f"job-{next(self._seq)}"
             if job_id in self._jobs and not self._jobs[job_id]._state_in(
                 *JobState.TERMINAL
             ):
-                raise AdmissionError(f"job name {job_id!r} is already active")
+                self._reject(job_id, f"job name {job_id!r} is already active")
             self._evict_old_terminal()
             job = Job(
                 job_id,
@@ -152,15 +170,37 @@ class JobManager:
                 edges_hint=edges_hint,
                 queue_depth=self.cfg.job_queue_depth,
                 ready=ready,
+                progress=progress,
             )
             job._manager = self
             self._jobs[job_id] = job
             self._admitted_bytes += state_bytes
+            # journal the submit BEFORE the scheduler can run the job: the
+            # scheduler's PENDING->RUNNING transition must get a later seq
+            # than job_submitted or replay's lifecycle chain breaks (the
+            # journal lock is a leaf lock — emitting under the manager
+            # lock is the documented-safe order)
+            events.journal().emit(
+                "job_submitted",
+                job=job_id,
+                weight=int(weight),
+                state_bytes=state_bytes,
+                checkpoint=bool(checkpoint_path),
+            )
             self._ensure_scheduler()
         if sink is not None:
             self._start_sink_thread(job)
         self._wake.set()
         return job
+
+    @staticmethod
+    def _reject(name: Optional[str], msg: str) -> None:
+        """Journal + raise one admission refusal (the journal records WHY
+        a submit bounced, not just that a counter moved)."""
+        events.journal().emit(
+            "admission_reject", job=name or "?", reason=msg
+        )
+        raise AdmissionError(msg)
 
     def submit_aggregation(
         self,
@@ -296,6 +336,12 @@ class JobManager:
             latency = metrics.job_latency_snapshot(job_id)
             if latency:
                 row["latency_ms"] = latency
+            health = metrics.job_health(job_id)
+            if health:
+                row["health"] = health
+            alerts = metrics.alerts_for("job", job_id)
+            if alerts:
+                row["alerts"] = alerts
             if dumps[job_id] is not None:
                 # the FAILED post-mortem: the flight recorder's last spans
                 # at the moment the job died (see _fail)
@@ -341,7 +387,11 @@ class JobManager:
         with self._lock:
             self._stop = True
             thread = self._thread
+            monitor = self._slo_monitor
+            self._slo_monitor = None
         self._wake.set()
+        if monitor is not None:
+            monitor.stop()
         if thread is not None:
             thread.join(timeout)
 
@@ -354,13 +404,22 @@ class JobManager:
     # -- scheduler internals -------------------------------------------------
 
     def _ensure_scheduler(self) -> None:
-        """Start the scheduler thread on first submit; caller holds _lock."""
+        """Start the scheduler thread on first submit; caller holds _lock.
+        The SLO monitor (when objectives are configured) starts and stops
+        with it — a manager that never runs a job never pays a thread."""
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._loop, name="gelly-job-scheduler", daemon=True
                 )
                 self._thread.start()
+            if self.cfg.slos and self._slo_monitor is None:
+                from gelly_streaming_tpu.runtime.slo import SLOMonitor
+
+                self._slo_monitor = SLOMonitor(
+                    self.cfg.slos, interval_s=self.cfg.slo_interval_s
+                )
+                self._slo_monitor.start()
 
     def _start_sink_thread(self, job: Job) -> None:
         """Per-job sink pump: drains the bounded queue into the sink on its
@@ -401,11 +460,15 @@ class JobManager:
     def _release(self, job: Job) -> None:
         """Return a terminal job's admitted bytes and drop its source
         closure (which may capture the whole input dataset) so a retained
-        terminal job costs bookkeeping, not data; caller holds _lock."""
+        terminal job costs bookkeeping, not data; caller holds _lock.
+        The job's health gauge row goes too — a DONE job's last backlog
+        reading must not keep an SLO alert burning (the metrics locks are
+        leaf locks, safe under the manager lock)."""
         with self._lock:
             self._admitted_bytes -= job.state_bytes
             job.state_bytes = 0  # idempotent: released exactly once
             job._build = None
+        metrics.drop_job_health(job.job_id)
 
     def _fail(self, job: Job, err: BaseException) -> None:
         """Mark FAILED from ANY thread (scheduler pull errors, sink pump
@@ -462,6 +525,21 @@ class JobManager:
                     progressed |= self._run_quantum(job)
                 except BaseException as e:  # defensive: never kill the loop
                     self._fail(job, e)
+            if self._health_every:
+                # the health plane's sampling point: BETWEEN rounds on the
+                # one scheduler thread, reading host-side Python counters
+                # only — the dispatch hot path above gains a clock check
+                # per round and zero device syncs
+                now = time.monotonic()
+                if now >= self._next_health:
+                    self._next_health = now + self._health_every
+                    try:
+                        self._sample_health(jobs, now)
+                    except Exception:
+                        # same invariant as the quantum loop: a malformed
+                        # progress dict (user-supplied probe) must degrade
+                        # a gauge sweep, never kill the ONE scheduler
+                        pass
             if not progressed:
                 # nothing runnable: park until a submit/resume/cancel or a
                 # consumer freeing queue space wakes us (short cap so a
@@ -587,6 +665,87 @@ class JobManager:
             metrics.job_add(job.job_id, "job_sched_rounds", 1)
             job._last_quantum_end = time.perf_counter()
         return bool(pulled)
+
+    def _sample_health(self, jobs, now: float) -> None:  # single-thread: scheduler
+        """One keep-up gauge sweep over the live jobs (ISSUE 10).
+
+        For jobs with a ``progress`` probe (network-fed sources) the full
+        vocabulary: watermark lag from the probe's positional accounting,
+        backlog depth/age from its queue snapshot, EWMA arrival vs drain
+        rates, the keep-up ratio, and a time-to-queue-full estimate.
+        Other jobs get sink-side gauges (drain rate, emission-queue
+        depth).  Terminal jobs lose their gauge rows — a DONE job's stale
+        backlog must not keep an SLO alert burning.
+
+        Each job's sample is individually fault-isolated (a malformed
+        user-supplied probe dict degrades THAT job's gauges for the
+        sweep, never the rest), and a probe that stops producing REPLACES
+        the row with sink-side figures — no frozen backlog/lag values
+        driving SLO verdicts after the source is gone.
+        """
+        for job in jobs:
+            try:
+                self._sample_job_health(job, now)
+            except Exception:
+                continue  # one bad probe must not abort the sweep
+        # trackers for jobs evicted between sweeps (terminal + evicted
+        # before a tick saw them) would otherwise accumulate forever in a
+        # long-lived churny server
+        live = {job.job_id for job in jobs}
+        for job_id in [j for j in self._keepup if j not in live]:
+            del self._keepup[job_id]
+
+    def _sample_job_health(self, job: Job, now: float) -> None:  # single-thread: scheduler
+        job_id = job.job_id
+        if job._state_in(*JobState.TERMINAL):
+            if self._keepup.pop(job_id, None) is not None:
+                metrics.drop_job_health(job_id)
+            return
+        gauges = {"out_queue_depth": job._out.qsize()}
+        prog = None
+        probe = job._progress
+        if probe is not None:
+            try:
+                prog = probe()
+            except BaseException:
+                prog = None  # a broken probe degrades, never fails a job
+        tracker = self._keepup.get(job_id)
+        if tracker is None:
+            tracker = self._keepup[job_id] = metrics.KeepUpTracker()
+        if prog is not None:
+            arrival, drain = tracker.sample(
+                now, prog["edges_in"], prog["edges_out"]
+            )
+            lag = max(
+                0, prog["closable_windows"] - prog["delivered_windows"]
+            )
+            backlog_edges = prog["backlog_edges"]
+            gauges.update(
+                watermark_lag_windows=lag,
+                backlog_batches=prog["backlog_batches"],
+                backlog_edges=backlog_edges,
+                backlog_age_s=round(prog["backlog_age_s"], 4),
+                arrival_eps=round(arrival, 2),
+                drain_eps=round(drain, 2),
+                keepup_ratio=(
+                    round(min(drain / arrival, 999.0), 4)
+                    if arrival > 1e-9
+                    else 1.0
+                ),
+            )
+            net = arrival - drain
+            headroom = prog["queue_capacity_edges"] - backlog_edges
+            # -1 = not filling (the JSON/Prometheus-safe "infinity")
+            gauges["time_to_queue_full_s"] = (
+                round(max(headroom, 0) / net, 2) if net > 1e-9 else -1.0
+            )
+        else:
+            # sink-side drain only: the job's attributed edge counter
+            # is the best cumulative drain figure available
+            edges = metrics.job_stats(job_id)["job_edges"]
+            _arrival, drain = tracker.sample(now, edges, edges)
+            gauges["drain_eps"] = round(drain, 2)
+        metrics.job_health_set(job_id, gauges)
 
     def _cancel_now(self, job: Job) -> None:  # single-thread: scheduler
         """Perform a requested cancel on the scheduler thread.

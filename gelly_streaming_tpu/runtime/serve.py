@@ -168,9 +168,27 @@ def main(argv=None) -> int:
         print("no jobs in config", file=sys.stderr)
         return 2
 
+    # health plane (ISSUE 10): declarative SLO specs, the gauge-sampling
+    # rate, and an optional JSONL event-journal path all ride the config
+    from gelly_streaming_tpu.core.config import SLOSpec
+    from gelly_streaming_tpu.utils import events
+
+    try:
+        slos = tuple(SLOSpec(**s) for s in conf.get("slos", []))
+    except (TypeError, ValueError) as e:
+        print(f"bad slos config: {e}", file=sys.stderr)
+        return 2
+    if conf.get("events_path"):
+        events.configure(
+            path=conf["events_path"],
+            max_bytes=int(conf.get("events_max_bytes", 4 << 20)),
+        )
     rt_cfg = RuntimeConfig(
         max_jobs=int(conf.get("max_jobs", max(8, len(specs)))),
         max_state_bytes=int(conf.get("max_state_bytes", 0)),
+        health_sample_s=float(conf.get("health_sample_s", 1.0)),
+        slos=slos,
+        slo_interval_s=float(conf.get("slo_interval_s", 0.5)),
     )
 
     def sink(rec):

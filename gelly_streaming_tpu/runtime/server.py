@@ -53,7 +53,7 @@ from gelly_streaming_tpu.core.config import (
 from gelly_streaming_tpu.runtime import protocol
 from gelly_streaming_tpu.runtime.job import AdmissionError, Job, JobState
 from gelly_streaming_tpu.runtime.manager import JobManager
-from gelly_streaming_tpu.utils import metrics
+from gelly_streaming_tpu.utils import events, metrics
 
 
 # server-side synthetic streams ("generate" submits) materialize host
@@ -340,6 +340,9 @@ class StreamServer:
         "status",
         "metrics",
         "trace",
+        "health",
+        "alerts",
+        "events",
         "pause",
         "resume",
         "cancel",
@@ -727,6 +730,7 @@ class StreamServer:
                         state_bytes=state_bytes,
                         edges_per_record=w or 0,
                         ready=source.ready,
+                        progress=source.progress,
                     )
                 else:
                     job = self.manager.submit_aggregation(
@@ -749,6 +753,16 @@ class StreamServer:
         if old is not None:
             old.abandon()  # a terminal predecessor's buffered records go
         metrics.tenant_add(tenant.tenant, "tenant_jobs_submitted", 1)
+        if resume_edges:
+            # the journal's restart-cursor record: a resumed job's replay
+            # region is part of the post-mortem story (which edges were
+            # checkpoint-covered vs re-pushed)
+            events.journal().emit(
+                "restart_cursor",
+                job=key,
+                tenant=tenant.tenant,
+                resume_edges=resume_edges,
+            )
         return (
             {
                 "ok": True,
@@ -780,24 +794,29 @@ class StreamServer:
                 and not sj.job._state_in(*JobState.TERMINAL)
             ]
         if tenant.max_jobs and len(live) >= tenant.max_jobs:
-            metrics.tenant_add(tenant.tenant, "tenant_admission_rejections", 1)
-            raise _Refused(
-                "admission",
+            self._reject_tenant(
+                tenant,
                 f"tenant job cap reached: {len(live)} live jobs >= "
                 f"max_jobs={tenant.max_jobs}",
             )
         if tenant.max_state_bytes:
             held = sum(sj.job.state_bytes for sj in live)
             if held + new_state_bytes > tenant.max_state_bytes:
-                metrics.tenant_add(
-                    tenant.tenant, "tenant_admission_rejections", 1
-                )
-                raise _Refused(
-                    "admission",
+                self._reject_tenant(
+                    tenant,
                     f"tenant state-byte cap reached: {held} held + "
                     f"{new_state_bytes} requested > "
                     f"max_state_bytes={tenant.max_state_bytes}",
                 )
+
+    @staticmethod
+    def _reject_tenant(tenant: TenantConfig, msg: str) -> None:
+        """Counter + journal + typed refusal for one tenant-cap bounce."""
+        metrics.tenant_add(tenant.tenant, "tenant_admission_rejections", 1)
+        events.journal().emit(
+            "admission_reject", tenant=tenant.tenant, reason=msg
+        )
+        raise _Refused("admission", msg)
 
     def _h_push(self, tenant, header, payload):
         sj = self._served(tenant, header)
@@ -1021,12 +1040,108 @@ class StreamServer:
             for k, v in hists.get("tenants", {}).items()
             if k == tenant.tenant
         }
+        snap["health"] = {
+            k: v
+            for k, v in snap.get("health", {}).items()
+            if k.startswith(prefix)
+        }
+        snap["alerts"] = [
+            a for a in snap.get("alerts", []) if self._alert_visible(a, tenant)
+        ]
         if header.get("format") == "prometheus":
             from gelly_streaming_tpu.utils.metrics import render_prometheus
 
             text = render_prometheus(snap).encode("utf-8")
             return {"ok": True, "format": "prometheus"}, text, False
         return {"ok": True, "metrics": snap}, b"", False
+
+    def _alert_visible(self, alert: dict, tenant: TenantConfig) -> bool:
+        """The disclosure rule for alert rows, matching status/metrics:
+        your jobs' alerts, your tenant-scope alerts, and global ones."""
+        scope = alert.get("scope")
+        if scope == "job":
+            return str(alert.get("id", "")).startswith(f"{tenant.tenant}/")
+        if scope == "tenant":
+            return alert.get("id") == tenant.tenant
+        return True
+
+    def _event_visible(self, ev: dict, tenant: TenantConfig) -> bool:
+        """Journal disclosure: events naming a job belong to its tenant
+        (prefix rule); events naming only a tenant likewise; alert events
+        follow the alert rule; everything else (process-plane) is shared."""
+        job = ev.get("job")
+        if isinstance(job, str) and "/" in job:
+            return job.startswith(f"{tenant.tenant}/")
+        if isinstance(job, str):
+            # a non-prefixed job id is a LOCAL (driver-submitted) job:
+            # not any remote tenant's to read
+            return False
+        if ev.get("kind") == "alert":
+            return self._alert_visible(
+                {"scope": ev.get("scope"), "id": ev.get("id")}, tenant
+            )
+        t = ev.get("tenant")
+        if isinstance(t, str):
+            return t == tenant.tenant
+        return True
+
+    def _h_health(self, tenant, header, payload):
+        """The keep-up verdict verb (ISSUE 10): this tenant's per-job
+        health gauges, the alert rows visible to it, the configured SLO
+        specs, and the monitor's own liveness figures."""
+        import dataclasses as _dc
+
+        prefix = f"{tenant.tenant}/"
+        jobs = {
+            k: v
+            for k, v in metrics.all_job_health().items()
+            if k.startswith(prefix)
+        }
+        alerts = [
+            a for a in metrics.all_alerts() if self._alert_visible(a, tenant)
+        ]
+        with self.manager._lock:
+            monitor = self.manager._slo_monitor
+        reply = {
+            "ok": True,
+            "health": {
+                "jobs": jobs,
+                "alerts": alerts,
+                "slos": [_dc.asdict(s) for s in self.manager.cfg.slos],
+                "monitor": monitor.stats() if monitor is not None else None,
+            },
+        }
+        return reply, b"", False
+
+    def _h_alerts(self, tenant, header, payload):
+        alerts = [
+            a for a in metrics.all_alerts() if self._alert_visible(a, tenant)
+        ]
+        return {"ok": True, "alerts": alerts}, b"", False
+
+    def _h_events(self, tenant, header, payload):
+        """Tail the structured event journal (tenant-scoped)."""
+        try:
+            n = int(header.get("n", 64))
+        except (TypeError, ValueError):
+            raise _Refused("bad-spec", "events 'n' must be an integer")
+        n = max(1, min(n, 4096))
+        kind = header.get("kind")
+        if kind is not None and not isinstance(kind, str):
+            raise _Refused("bad-spec", "events 'kind' must be a string")
+        journal = events.journal()
+        # over-fetch before the visibility filter so n VISIBLE events come
+        # back even when other tenants are chatty (ring is bounded anyway)
+        items = [
+            ev
+            for ev in journal.tail(journal.capacity, kind=kind)
+            if self._event_visible(ev, tenant)
+        ][-n:]
+        return (
+            {"ok": True, "events": items, "journal": journal.stats()},
+            b"",
+            False,
+        )
 
     @staticmethod
     def _totals_over(rows) -> dict:
@@ -1130,6 +1245,12 @@ class StreamServer:
                 "state": job.state if job is not None else "PENDING",
                 "records_pending": sj.pending_records(),
             }
+            events.journal().emit(
+                "drain_cursor",
+                job=self._job_key(tenant, sj.name),
+                tenant=tenant.tenant,
+                resume_edges=cursor,
+            )
         if header.get("shutdown"):
             self._shutdown_requested.set()
         return {"ok": True, "cursors": cursors}, b"", False

@@ -1,0 +1,301 @@
+"""SLO burn-rate monitor: gauges -> keep-up verdicts -> alerts (ISSUE 10).
+
+PR 8 measured (histograms, spans); the health sampler in
+runtime/manager.py now interprets per-job progress (lag, backlog age,
+keep-up ratio).  This module closes the loop: declarative objectives
+(:class:`core.config.SLOSpec`) are evaluated on their own monitor thread
+against the EXISTING registries — latency histograms via cumulative
+(count, over-threshold) diffs, health gauges via per-tick samples — and
+drive an OK -> WARN -> PAGE state machine whose transitions land in the
+alert registry (status rows, ``health``/``alerts`` verbs, Prometheus
+``gelly_slo_state``) and the structured event journal.
+
+Burn-rate math (the SRE multiwindow pattern): an objective tolerates an
+ERROR BUDGET — the fraction of samples allowed on the wrong side of the
+threshold (``p99_..._ms`` derives 1%; gauge objectives default to 10% of
+monitor ticks).  Each evaluation computes the bad-sample fraction over a
+FAST and a SLOW trailing window; ``burn = fraction / budget``.  Both
+windows at ``warn_burn``+ raises WARN, both at ``page_burn``+ raises
+PAGE: the fast window makes paging responsive to a fresh stall, the slow
+window keeps a single bad tick from paging, and requiring BOTH is what
+distinguishes "burning now" from "burned once, long ago".  De-escalation
+is hysteretic — one level down per ``clear_hold`` consecutive below-warn
+evaluations — so a metric hovering at the threshold cannot flap
+OK <-> PAGE at tick rate.
+
+Threading: every piece of evaluation state (sample windows, alert state
+machines) is owned by the monitor thread — the only shared mutations go
+through the lock-guarded registries in utils/metrics.py and the journal.
+The monitor reads host-side counters only; it can never sync the device
+or block a data-plane thread (the graftcheck corpus pair
+tests/analysis_corpus/{good,bad}_events.py pins both disciplines).  The
+clock is injectable, so tests walk WARN -> PAGE -> clear deterministically
+by scripting time instead of sleeping through it.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+from gelly_streaming_tpu.core.config import SLOSpec
+from gelly_streaming_tpu.utils import events, metrics
+
+#: alert severity order (shared numeric mapping lives in
+#: utils.metrics.ALERT_LEVELS for the Prometheus exposition)
+OK, WARN, PAGE = "OK", "WARN", "PAGE"
+_LEVEL = metrics.ALERT_LEVELS
+_DOWN = {PAGE: WARN, WARN: OK, OK: OK}
+
+#: SLOSpec.scope -> histogram registry kind (global uses scope id "")
+_HIST_KIND = {"job": "job", "tenant": "tenant", "global": "global"}
+
+
+class _Instance:
+    """Evaluation state for ONE (spec, scope id) pair.
+
+    ``samples`` is a deque of ``(t, total, bad)``: per-tick (1, 0/1)
+    entries for gauge objectives, cumulative histogram pairs for latency
+    objectives (windowed fractions come from diffing against the newest
+    sample at or before the window start).  All fields are monitor-thread
+    private — no lock.
+    """
+
+    __slots__ = ("samples", "state", "streak", "since")
+
+    def __init__(self, now: float):
+        self.samples: deque = deque()
+        self.state = OK
+        self.streak = 0
+        self.since = now
+
+    def frac_over(self, now: float, window_s: float, cumulative: bool) -> float:
+        """Bad-sample fraction across the trailing window."""
+        start = now - window_s
+        if cumulative:
+            if not self.samples:
+                return 0.0
+            base = None
+            for t, total, bad in self.samples:
+                if t <= start:
+                    base = (total, bad)
+                else:
+                    break
+            if base is None:
+                # window predates history: the first sample is the zero
+                # point (its own deltas were never observed by this monitor)
+                base = (self.samples[0][1], self.samples[0][2])
+            _t, total_now, bad_now = self.samples[-1]
+            total = total_now - base[0]
+            bad = bad_now - base[1]
+            return bad / total if total > 0 else 0.0
+        total = 0
+        bad = 0
+        for t, n, b in self.samples:
+            if t > start:
+                total += n
+                bad += b
+        return bad / total if total > 0 else 0.0
+
+    def prune(self, now: float, keep_s: float) -> None:
+        """Drop samples older than the slow window, keeping ONE sample at
+        or before the boundary as the cumulative baseline."""
+        start = now - keep_s
+        while len(self.samples) >= 2 and self.samples[1][0] <= start:
+            self.samples.popleft()
+
+
+class SLOMonitor:
+    """Evaluate a tuple of :class:`SLOSpec` against the live registries.
+
+    ``evaluate_once(now)`` is the public, deterministic unit (tests drive
+    it with scripted clocks); ``start()`` runs it on a daemon thread every
+    ``interval_s`` seconds.  Instances (live jobs/tenants matching a
+    spec's target pattern) are discovered per evaluation and pruned when
+    their registry rows disappear — retiring their alert rows with them,
+    so an evicted job cannot leave a PAGE burning forever.
+    """
+
+    def __init__(
+        self,
+        specs,
+        interval_s: float = 0.5,
+        clock=time.monotonic,
+        journal: Optional[events.EventJournal] = None,
+    ):
+        self.specs: Tuple[SLOSpec, ...] = tuple(specs)
+        for spec in self.specs:
+            if not isinstance(spec, SLOSpec):
+                raise TypeError(f"not an SLOSpec: {spec!r}")
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._journal = journal
+        self.evaluations = 0  # single-thread: slo-monitor
+        self._instances: dict = {}  # single-thread: slo-monitor
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "SLOMonitor":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="gelly-slo-monitor", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "SLOMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _loop(self) -> None:  # single-thread: slo-monitor
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                # a monitor bug must degrade observability, never kill
+                # the thread watching for exactly such degradations
+                continue
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _scope_ids(self, spec: SLOSpec) -> List[str]:
+        """Live instances of a spec's scope, filtered by its target
+        pattern.  Gauge objectives discover through the health registry
+        ONLY — a job whose gauges were dropped (terminal) must stop being
+        evaluated even while its histograms linger for post-mortems."""
+        kind = spec.kind()
+        if spec.scope == "global":
+            return [""]
+        if spec.scope == "job":
+            ids = set(metrics.all_job_health())
+            if kind[0] == "hist":
+                ids |= metrics.hist_scopes("job")
+        else:
+            ids = set(metrics.all_tenant_stats())
+            ids |= metrics.hist_scopes("tenant")
+        return sorted(i for i in ids if fnmatch.fnmatch(i, spec.target))
+
+    def _measure(self, spec: SLOSpec, sid: str, inst: _Instance, now: float):
+        """Append this tick's sample; returns (cumulative?, gauge value)
+        or None when the instance has no data for the metric."""
+        kind = spec.kind()
+        if kind[0] == "gauge":
+            row = metrics.job_health(sid)
+            value = row.get(kind[1])
+            if value is None:
+                return None
+            bad = value > spec.threshold if kind[2] == "gt" else value < spec.threshold
+            inst.samples.append((now, 1, 1 if bad else 0))
+            return False, value
+        count, over = metrics.hist_totals_over(
+            _HIST_KIND[spec.scope], sid, kind[1], spec.threshold
+        )
+        inst.samples.append((now, count, over))
+        return True, None
+
+    def evaluate_once(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation sweep; returns the state TRANSITIONS it caused
+        (each also journaled and reflected in the alert registry)."""
+        now = self._clock() if now is None else now
+        transitions: List[dict] = []
+        seen = set()
+        for idx, spec in enumerate(self.specs):
+            budget = spec.budget()
+            for sid in self._scope_ids(spec):
+                key = (idx, sid)
+                seen.add(key)
+                inst = self._instances.get(key)
+                if inst is None:
+                    inst = self._instances[key] = _Instance(now)
+                measured = self._measure(spec, sid, inst, now)
+                if measured is None:
+                    continue
+                cumulative, value = measured
+                inst.prune(now, spec.slow_window_s + 2 * self.interval_s)
+                frac_fast = inst.frac_over(now, spec.fast_window_s, cumulative)
+                frac_slow = inst.frac_over(now, spec.slow_window_s, cumulative)
+                burn_fast = frac_fast / budget
+                burn_slow = frac_slow / budget
+                if burn_fast >= spec.page_burn and burn_slow >= spec.page_burn:
+                    target = PAGE
+                elif burn_fast >= spec.warn_burn and burn_slow >= spec.warn_burn:
+                    target = WARN
+                else:
+                    target = OK
+                old = inst.state
+                new = old
+                if _LEVEL[target] > _LEVEL[old]:
+                    # escalation is immediate: a fresh burn must not wait
+                    # out a clear-hold meant for the way down
+                    new = target
+                    inst.streak = 0
+                elif _LEVEL[target] < _LEVEL[old]:
+                    inst.streak += 1
+                    if inst.streak >= spec.clear_hold:
+                        new = _DOWN[old]
+                        inst.streak = 0
+                else:
+                    inst.streak = 0
+                if new != old:
+                    inst.state = new
+                    inst.since = now
+                    tr = {
+                        "scope": spec.scope,
+                        "id": sid,
+                        "slo": spec.alert_name(),
+                        "from": old,
+                        "to": new,
+                        "metric": spec.metric,
+                        "threshold": spec.threshold,
+                        "burn_fast": round(burn_fast, 4),
+                        "burn_slow": round(burn_slow, 4),
+                    }
+                    transitions.append(tr)
+                    (self._journal or events.journal()).emit("alert", **tr)
+                row = {
+                    "state": inst.state,
+                    "metric": spec.metric,
+                    "threshold": spec.threshold,
+                    "burn_fast": round(burn_fast, 4),
+                    "burn_slow": round(burn_slow, 4),
+                    "bad_frac_fast": round(frac_fast, 4),
+                    "bad_frac_slow": round(frac_slow, 4),
+                    "budget": budget,
+                    "since": round(inst.since, 4),
+                }
+                if value is not None:
+                    row["value"] = round(float(value), 4)
+                metrics.alert_set(spec.scope, sid, spec.alert_name(), row)
+        # prune instances whose registry rows disappeared (evicted jobs,
+        # reset registries) and retire their alert rows — per spec name,
+        # so another spec's alert on the same id is untouched
+        for key in [k for k in self._instances if k not in seen]:
+            idx, sid = key
+            spec = self.specs[idx]
+            del self._instances[key]
+            metrics.drop_alert(spec.scope, sid, spec.alert_name())
+        self.evaluations += 1
+        return transitions
+
+    def stats(self) -> dict:
+        return {
+            "specs": len(self.specs),
+            "evaluations": self.evaluations,
+            "instances": len(self._instances),
+            "interval_s": self.interval_s,
+            "running": self._thread is not None and self._thread.is_alive(),
+        }

@@ -37,13 +37,32 @@ def _quantiles(hist_rows: dict, name: str) -> str:
     return f"{row['p50_ms']:.1f}/{row['p99_ms']:.1f}"
 
 
+def _alert_badge(alerts: list, job_id: Optional[str] = None) -> str:
+    """Compact badge for a job's (or the frame's) worst alert: ``P!slo`` /
+    ``W:slo`` / ``-``."""
+    rows = [
+        a
+        for a in alerts
+        if job_id is None or (a.get("scope") == "job" and a.get("id") == job_id)
+    ]
+    page = [a for a in rows if a.get("state") == "PAGE"]
+    warn = [a for a in rows if a.get("state") == "WARN"]
+    if page:
+        return f"P!{page[0]['slo']}"
+    if warn:
+        return f"W:{warn[0]['slo']}"
+    return "-"
+
+
 def render_frame(
     status: dict,
     metrics_snap: dict,
     prev: Optional[dict],
     dt: Optional[float],
+    health: Optional[dict] = None,
 ) -> list:
-    """One frame's console lines from a status reply + metrics snapshot.
+    """One frame's console lines from a status reply + metrics snapshot
+    (+ the ``health`` verb's reply, when polled).
 
     ``prev``/``dt`` carry the previous poll's per-job edge counters for
     the eps column (None on the first frame).  Pure function of its
@@ -82,6 +101,35 @@ def render_frame(
             f"{_quantiles(hrows, 'window_close_to_emission_ms'):>16} "
             f"{first_s:>14}"
         )
+    if health:
+        hjobs = health.get("jobs", {})
+        alerts = health.get("alerts", [])
+        if hjobs or alerts:
+            lines.append(
+                f"{'HEALTH':<24} {'LAG(w)':>7} {'BACKLOG':>8} {'AGE s':>7} "
+                f"{'ARR eps':>8} {'DRN eps':>8} {'KEEPUP':>7} {'TTF s':>7} "
+                f"ALERT"
+            )
+        for job_id in sorted(hjobs):
+            row = hjobs[job_id]
+            ttf = row.get("time_to_queue_full_s", -1.0)
+            lines.append(
+                f"{job_id:<24.24} {row.get('watermark_lag_windows', 0):>7} "
+                f"{row.get('backlog_batches', 0):>8} "
+                f"{row.get('backlog_age_s', 0.0):>7.2f} "
+                f"{_fmt_eps(row.get('arrival_eps')):>8} "
+                f"{_fmt_eps(row.get('drain_eps')):>8} "
+                f"{row.get('keepup_ratio', 1.0):>7.2f} "
+                f"{('-' if ttf is None or ttf < 0 else f'{ttf:.0f}'):>7} "
+                f"{_alert_badge(alerts, job_id)}"
+            )
+        for a in alerts:
+            if a.get("scope") != "job":
+                lines.append(
+                    f"alert [{a.get('state')}] {a.get('scope')}:"
+                    f"{a.get('id') or '*'} {a.get('slo')} "
+                    f"burn={a.get('burn_fast')}/{a.get('burn_slow')}"
+                )
     tenants = metrics_snap.get("tenants", {})
     if tenants:
         lines.append(
@@ -103,6 +151,40 @@ def render_frame(
     return lines
 
 
+def frame_dict(
+    status: dict,
+    metrics_snap: dict,
+    prev: Optional[dict],
+    dt: Optional[float],
+    health: Optional[dict] = None,
+) -> dict:
+    """The machine-readable frame (``--json``): the SAME view the console
+    renders, as one JSON-ready object per poll — per-job status rows with
+    the computed eps delta, tenant ledger, health gauges, and alert rows.
+    Pure function of its inputs (tests pin the shape without a server)."""
+    jobs = {}
+    for job_id, row in status.get("status", {}).get("jobs", {}).items():
+        out = dict(row)
+        if prev is not None and dt and job_id in prev:
+            out["eps"] = round(
+                max(0.0, (row.get("job_edges", 0) - prev[job_id]) / dt), 2
+            )
+        else:
+            out["eps"] = None
+        jobs[job_id] = out
+    health = health or {}
+    return {
+        "server": status.get("server", {}),
+        "jobs": jobs,
+        "tenants": metrics_snap.get("tenants", {}),
+        "pipeline": metrics_snap.get("pipeline", {}),
+        "spans": metrics_snap.get("spans", {}),
+        "histograms": metrics_snap.get("histograms", {}),
+        "health": health.get("jobs", {}),
+        "alerts": health.get("alerts", []),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="gelly-top",
@@ -119,6 +201,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--once", action="store_true", help="print one frame and exit"
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable frames: one JSON object per poll instead "
+        "of the console tables (--once --json emits exactly one object)",
     )
     parser.add_argument(
         "--frames",
@@ -138,18 +226,30 @@ def main(argv=None) -> int:
     prev_t: Optional[float] = None
     frames = 0
     interactive = (
-        not args.once and sys.stdout.isatty()
+        not args.once and not args.json and sys.stdout.isatty()
     )
     with GellyClient(host, port, token=args.token) as client:
         while True:
             status = client.status()
             snap = client.metrics()
+            health = client.health()
             now = time.monotonic()
             dt = (now - prev_t) if prev_t is not None else None
-            lines = render_frame(status, snap, prev_edges, dt)
-            if interactive:
-                sys.stdout.write("\x1b[2J\x1b[H")
-            print("\n".join(lines), flush=True)
+            if args.json:
+                import json as _json
+
+                print(
+                    _json.dumps(
+                        frame_dict(status, snap, prev_edges, dt, health),
+                        sort_keys=True,
+                    ),
+                    flush=True,
+                )
+            else:
+                lines = render_frame(status, snap, prev_edges, dt, health)
+                if interactive:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print("\n".join(lines), flush=True)
             prev_edges = {
                 job_id: row.get("job_edges", 0)
                 for job_id, row in status.get("status", {})

@@ -1,0 +1,301 @@
+"""Structured event journal: the health plane's audit trail (ISSUE 10).
+
+PR 8's flight recorder answers "where did this window's time go"; nothing
+answered "what happened to this JOB, in order".  When a job fails — or a
+drain/restart cursor disagrees with what a client expected — the only
+post-mortem artifact was the last few spans.  This module records the
+DISCRETE happenings as structured events:
+
+* ``job_submitted`` / ``job_transition`` — the lifecycle state machine
+  (runtime/job.py), including the error on a FAILED transition;
+* ``admission_reject`` — submits refused by the manager's or a tenant's
+  admission control (the rejection reason, not just a counter bump);
+* ``drain_cursor`` / ``restart_cursor`` — the positional cursors handed
+  out by the serving plane's drain verb and read back at resubmit;
+* ``alert`` — SLO state-machine transitions (runtime/slo.py), with the
+  burn rates that drove them.
+
+Storage is two-tier, both lock-guarded under the journal's ONE lock:
+
+* an always-on bounded in-memory ring (``capacity`` events) — what the
+  server's ``events`` verb tails; costs a dict + deque append per event,
+  and events are lifecycle-rate (transitions, alerts), never per-window;
+* an optional JSONL file (``path`` / ``GELLY_EVENTS_PATH``), one
+  ``json.dumps`` line per event, with SIZE-BASED ROTATION: when the file
+  exceeds ``max_bytes`` it is renamed to ``path.1`` (older generations
+  shift up to ``path.keep``) and a fresh file is opened — bounded disk,
+  no external logrotate dependency.
+
+Events carry a monotonic ``seq`` (per journal) and a wall-clock ``ts``,
+so :func:`replay` + :func:`job_lifecycle` reconstruct a job's exact state
+sequence from the file — the acceptance contract: a post-mortem replays
+the sequence that led to a FAILED job instead of guessing from spans.
+
+The journal lock is a LEAF lock: ``emit`` never calls back into manager /
+metrics code, so emitting while holding the manager lock (job transitions
+do) cannot deadlock.  File-write failures (disk full, rotated directory
+gone) disable the file mirror and count ``write_errors`` — they never
+propagate into the scheduler or a connection handler.
+
+File writes are SYNCHRONOUS by design: the journal is the crash
+post-mortem, so a transition's record is on disk before the transition
+proceeds — the same contract (and the same thread) as the positional
+checkpoints, which already write snapshots synchronously on the
+scheduler.  The flip side is identical too: a STALLED (not failing)
+filesystem stalls job transitions exactly as it stalls checkpoints, so
+point ``events_path`` at local disk, not a network mount.  Events are
+lifecycle-rate and a line is tens of bytes, so the steady-state cost is
+noise next to one checkpoint save.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+def _open_append(path: str):
+    """(file handle or None, current size, error count) — pure helper so
+    the journal's guarded attributes are only ever assigned under its
+    lock where the analyzer can see the ``with``."""
+    try:
+        f = open(path, "a", encoding="utf-8")
+        return f, f.tell(), 0
+    except OSError:
+        return None, 0, 1
+
+
+def _shift_generations(path: str, keep: int) -> int:
+    """Rotate ``path`` -> ``path.1`` (older generations shift up to
+    ``path.keep``); returns the error count (0/1)."""
+    try:
+        for k in range(keep, 1, -1):
+            older = f"{path}.{k - 1}"
+            if os.path.exists(older):
+                os.replace(older, f"{path}.{k}")
+        os.replace(path, f"{path}.1")
+        return 0
+    except OSError:
+        return 1
+
+
+class EventJournal:
+    """Bounded ring + optional rotating JSONL mirror of structured events.
+
+    ``clock`` is injectable (tests pin deterministic timestamps); it must
+    return wall-clock seconds (``time.time`` semantics — replay wants
+    real-world timestamps, not process-relative ones).
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        max_bytes: int = 4 << 20,
+        keep: int = 2,
+        capacity: int = 1024,
+        clock=time.time,
+    ):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        if keep < 1:
+            raise ValueError("keep must be >= 1 rotated generation")
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.keep = int(keep)
+        self.capacity = max(8, int(capacity))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._file = None  # guarded-by: _lock
+        self._nbytes = 0  # guarded-by: _lock
+        self._write_errors = 0  # guarded-by: _lock
+        if path:
+            with self._lock:
+                self._file, self._nbytes, err = _open_append(path)
+                self._write_errors += err
+
+    # -- producer side -------------------------------------------------------
+
+    def emit(self, kind: str, **fields) -> dict:
+        """Record one event; returns the stored record (seq/ts stamped).
+
+        Serialization happens under the lock so the file's line order is
+        the seq order — replay never has to re-sort.
+        """
+        with self._lock:
+            record = {"seq": self._seq, "ts": round(self._clock(), 6), "kind": kind}
+            record.update(fields)
+            self._seq += 1
+            self._ring.append(record)
+            if self._file is not None:
+                line = json.dumps(record, sort_keys=True) + "\n"
+                try:
+                    self._file.write(line)
+                    self._file.flush()
+                    self._nbytes += len(line)
+                    if self._nbytes > self.max_bytes:
+                        # size-based rotation: shift path.k generations up,
+                        # rename the full file to path.1, reopen fresh
+                        try:
+                            self._file.close()
+                        except OSError:
+                            pass
+                        self._write_errors += _shift_generations(
+                            self.path, self.keep
+                        )
+                        self._file, self._nbytes, err = _open_append(
+                            self.path
+                        )
+                        self._write_errors += err
+                except OSError:
+                    # a full disk must not take the scheduler down with it
+                    self._write_errors += 1
+                    try:
+                        self._file.close()
+                    except OSError:
+                        pass
+                    self._file = None
+        return record
+
+    # -- consumer side -------------------------------------------------------
+
+    def tail(
+        self,
+        n: int = 64,
+        kind: Optional[str] = None,
+        job: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> List[dict]:
+        """The most recent ``n`` ring events (oldest first), optionally
+        filtered by kind / exact job id / exact tenant id."""
+        with self._lock:
+            items = list(self._ring)
+        if kind is not None:
+            items = [e for e in items if e.get("kind") == kind]
+        if job is not None:
+            items = [e for e in items if e.get("job") == job]
+        if tenant is not None:
+            items = [e for e in items if e.get("tenant") == tenant]
+        n = int(n)
+        return items[len(items) - n:] if n > 0 else []
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "events_emitted": self._seq,
+                "events_held": len(self._ring),
+                "events_capacity": self.capacity,
+                "events_file": self.path,
+                "events_file_bytes": self._nbytes if self._file else 0,
+                "events_write_errors": self._write_errors,
+            }
+
+    def clear(self) -> None:
+        """Drop ring contents (the file, if any, keeps its lines)."""
+        with self._lock:
+            self._ring.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+# ---------------------------------------------------------------------------
+# the process-global journal (same pattern as tracing.flight_recorder)
+
+
+_JOURNAL_LOCK = threading.Lock()
+_JOURNAL: Optional[EventJournal] = None  # guarded-by: _JOURNAL_LOCK
+
+
+def _journal_from_env() -> EventJournal:
+    path = os.environ.get("GELLY_EVENTS_PATH") or None
+    try:
+        max_bytes = int(os.environ.get("GELLY_EVENTS_MAX_BYTES", 4 << 20))
+    except ValueError:
+        max_bytes = 4 << 20
+    return EventJournal(path=path, max_bytes=max(1, max_bytes))
+
+
+def journal() -> EventJournal:
+    """The process-global journal (ring-only unless ``GELLY_EVENTS_PATH``
+    is set or :func:`configure` installed a file-backed one)."""
+    global _JOURNAL
+    with _JOURNAL_LOCK:
+        if _JOURNAL is None:
+            _JOURNAL = _journal_from_env()
+        return _JOURNAL
+
+
+def configure(path: Optional[str] = None, **kw) -> EventJournal:
+    """Install a fresh process-global journal (closing the old one).
+    ``path=None`` gives a ring-only journal — what tests use to isolate."""
+    global _JOURNAL
+    new = EventJournal(path=path, **kw)
+    with _JOURNAL_LOCK:
+        old, _JOURNAL = _JOURNAL, new
+    if old is not None:
+        old.close()
+    return new
+
+
+# ---------------------------------------------------------------------------
+# replay: JSONL file -> events -> a job's reconstructed lifecycle
+
+
+def replay(path: str) -> List[dict]:
+    """Parse one journal file back into its event records (seq order).
+
+    Tolerates a torn final line (a crash mid-write is exactly when replay
+    matters); any other malformed line raises — silent corruption would
+    make the post-mortem lie.
+    """
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            if i == len(lines) - 1:
+                break  # torn tail from a crash mid-write
+            raise
+    out.sort(key=lambda e: e.get("seq", 0))
+    return out
+
+
+def job_lifecycle(events: List[dict], job: str) -> List[str]:
+    """Reconstruct one job's state sequence from replayed events:
+    ``["PENDING", "RUNNING", ..., terminal]``.  Raises on a broken chain
+    (a transition whose ``from`` is not the current state) — the journal
+    is supposed to be a complete record, and a gap must be loud."""
+    states: List[str] = []
+    for ev in events:
+        if ev.get("job") != job:
+            continue
+        if ev.get("kind") == "job_submitted":
+            states = ["PENDING"]
+        elif ev.get("kind") == "job_transition":
+            if states and ev.get("from") != states[-1]:
+                raise ValueError(
+                    f"journal gap for job {job!r}: transition from "
+                    f"{ev.get('from')!r} but last recorded state is "
+                    f"{states[-1]!r}"
+                )
+            if not states:
+                states = [ev.get("from")]
+            states.append(ev.get("to"))
+    return states

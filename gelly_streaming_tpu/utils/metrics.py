@@ -451,6 +451,11 @@ def drop_job_stats(job_id: str) -> None:
     with _HIST_LOCK:
         for key in [k for k in _HISTS if k[0] == "job" and k[1] == job_id]:
             del _HISTS[key]
+    # the health plane's rows leave with the job too: gauges (a stale
+    # backlog row would keep an SLO alert burning on a dead job) and the
+    # job's alert rows themselves
+    drop_job_health(job_id)
+    drop_alerts("job", job_id)
 
 
 def reset_job_stats() -> None:
@@ -558,6 +563,186 @@ def reset_tenant_stats() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Per-job health gauges (the streaming health plane, ISSUE 10).  Where the
+# counter registries above record what HAPPENED, these record whether each
+# job is KEEPING UP with its stream right now: watermark lag, backlog depth
+# and age, EWMA arrival vs drain rates, and the derived keep-up ratio /
+# time-to-queue-full estimate.  Written by the scheduler loop's 1 Hz-ish
+# sampler (runtime/manager.py _sample_health — plain Python counter reads,
+# never a device sync), read by status()/the health verb/gelly-top/the SLO
+# monitors, so the registry is lock-guarded like its siblings.
+#
+# Gauge vocabulary (all per job):
+#   watermark_lag_windows   closable-but-undelivered ingest windows (the
+#                           positional accounting NetworkEdgeSource.ready
+#                           already does, surfaced as a gauge)
+#   backlog_batches/edges   decoded batches queued ahead of the fold
+#   backlog_age_s           age of the OLDEST queued batch (how long the
+#                           job has not been keeping up, not just whether)
+#   arrival_eps/drain_eps   EWMA edge rates in and out of the source queue
+#   keepup_ratio            drain/arrival (>= 1.0 = keeping up)
+#   time_to_queue_full_s    backlog headroom / net inflow (-1 = not
+#                           filling; the operator's "minutes to stall")
+#   out_queue_depth         emission-queue occupancy (sink-side backlog)
+
+
+_HEALTH_LOCK = threading.Lock()
+# job id -> gauge dict; rows appear at first sample, leave with the job
+# (terminal transition / eviction), so a DONE job cannot hold a stale
+# backlog gauge that wedges an SLO alert open
+_JOB_HEALTH: dict = {}  # guarded-by: _HEALTH_LOCK
+
+
+class KeepUpTracker:
+    """EWMA arrival/drain rate estimator for ONE job's cumulative edge
+    counters.  Owned by the scheduler loop (single producer — no lock):
+    ``sample`` takes (now, edges_in, edges_out) and maintains half-life
+    smoothed rates, so a bursty client doesn't flap the keep-up verdict
+    while a sustained imbalance converges within a few half-lives."""
+
+    __slots__ = ("halflife_s", "arrival_eps", "drain_eps", "_t", "_in", "_out", "_seeded")
+
+    def __init__(self, halflife_s: float = 5.0):
+        self.halflife_s = float(halflife_s)
+        self.arrival_eps = 0.0
+        self.drain_eps = 0.0
+        self._t: Optional[float] = None
+        self._in = 0
+        self._out = 0
+        self._seeded = False
+
+    def sample(self, now: float, edges_in: int, edges_out: int):
+        """Fold one sample; returns (arrival_eps, drain_eps)."""
+        if self._t is None:
+            self._t, self._in, self._out = now, int(edges_in), int(edges_out)
+            return self.arrival_eps, self.drain_eps
+        dt = now - self._t
+        if dt <= 0:
+            return self.arrival_eps, self.drain_eps
+        inst_in = max(0.0, (int(edges_in) - self._in) / dt)
+        inst_out = max(0.0, (int(edges_out) - self._out) / dt)
+        self._t, self._in, self._out = now, int(edges_in), int(edges_out)
+        if not self._seeded:
+            self._seeded = True
+            self.arrival_eps, self.drain_eps = inst_in, inst_out
+        else:
+            alpha = 1.0 - 0.5 ** (dt / max(self.halflife_s, 1e-6))
+            self.arrival_eps += alpha * (inst_in - self.arrival_eps)
+            self.drain_eps += alpha * (inst_out - self.drain_eps)
+        return self.arrival_eps, self.drain_eps
+
+
+def job_health_update(job_id: str, gauges: dict) -> None:
+    """Merge gauges into a job's health row (partial writers: tests,
+    external instrumentation)."""
+    with _HEALTH_LOCK:
+        row = _JOB_HEALTH.get(job_id)
+        if row is None:
+            row = _JOB_HEALTH[job_id] = {}
+        row.update(gauges)
+
+
+def job_health_set(job_id: str, gauges: dict) -> None:
+    """REPLACE a job's health row with one sweep's complete gauge set —
+    what the scheduler's sampler uses, so a probe that stops producing
+    (source torn down mid-drain) cannot leave last sweep's backlog/lag
+    values frozen in the row driving SLO verdicts forever."""
+    with _HEALTH_LOCK:
+        _JOB_HEALTH[job_id] = dict(gauges)
+
+
+def job_health(job_id: str) -> dict:
+    """One job's gauge row ({} until the sampler has seen it)."""
+    with _HEALTH_LOCK:
+        return dict(_JOB_HEALTH.get(job_id) or {})
+
+
+def all_job_health() -> dict:
+    """{job id -> gauge dict} snapshot of every sampled live job."""
+    with _HEALTH_LOCK:
+        return {jid: dict(row) for jid, row in _JOB_HEALTH.items()}
+
+
+def drop_job_health(job_id: str) -> None:
+    """Forget a job's gauge row (terminal transition / eviction) so SLO
+    monitors stop evaluating it and its alerts can be retired."""
+    with _HEALTH_LOCK:
+        _JOB_HEALTH.pop(job_id, None)
+
+
+def reset_job_health() -> None:
+    with _HEALTH_LOCK:
+        _JOB_HEALTH.clear()
+
+
+# ---------------------------------------------------------------------------
+# SLO alert registry (runtime/slo.py writes, everything else reads).  One
+# row per (scope kind, scope id, slo name): current OK/WARN/PAGE state, the
+# burn rates that justify it, and the transition timestamp — surfaced in
+# job/tenant status rows, the health/alerts verbs, gelly-top badges, and
+# the Prometheus exposition (gelly_slo_state 0/1/2).
+
+
+ALERT_LEVELS = {"OK": 0, "WARN": 1, "PAGE": 2}
+
+_ALERT_LOCK = threading.Lock()
+_ALERTS: dict = {}  # guarded-by: _ALERT_LOCK  (scope, id, slo) -> row
+
+
+def alert_set(scope: str, scope_id: str, slo: str, row: dict) -> None:
+    """Install/refresh one alert row (the monitor calls this every
+    evaluation, transition or not, so burn rates stay current)."""
+    with _ALERT_LOCK:
+        _ALERTS[(scope, scope_id, slo)] = dict(
+            row, scope=scope, id=scope_id, slo=slo
+        )
+
+
+def alert_state(scope: str, scope_id: str, slo: str) -> Optional[dict]:
+    with _ALERT_LOCK:
+        row = _ALERTS.get((scope, scope_id, slo))
+        return dict(row) if row is not None else None
+
+
+def all_alerts() -> List[dict]:
+    """Every alert row, sorted by (scope, id, slo) for stable exposition."""
+    with _ALERT_LOCK:
+        items = sorted(_ALERTS.items())
+    return [dict(row) for _key, row in items]
+
+
+def alerts_for(scope: str, scope_id: str) -> List[dict]:
+    """The alert rows attached to one scope instance (a job's status row)."""
+    with _ALERT_LOCK:
+        items = sorted(
+            (key, row)
+            for key, row in _ALERTS.items()
+            if key[0] == scope and key[1] == scope_id
+        )
+    return [dict(row) for _key, row in items]
+
+
+def drop_alert(scope: str, scope_id: str, slo: str) -> None:
+    """Retire ONE alert row (the monitor pruning a dead instance of one
+    spec — other specs' alerts on the same id stay)."""
+    with _ALERT_LOCK:
+        _ALERTS.pop((scope, scope_id, slo), None)
+
+
+def drop_alerts(scope: str, scope_id: str) -> None:
+    """Retire every alert row of one scope instance (job eviction, or the
+    monitor pruning an instance whose gauges disappeared)."""
+    with _ALERT_LOCK:
+        for key in [k for k in _ALERTS if k[0] == scope and k[1] == scope_id]:
+            del _ALERTS[key]
+
+
+def reset_alerts() -> None:
+    with _ALERT_LOCK:
+        _ALERTS.clear()
+
+
+# ---------------------------------------------------------------------------
 # Bounded latency histograms (the observability plane, ISSUE 9).  Named
 # log-bucketed histograms registered per scope — process-global, per-job,
 # per-tenant — beside the counter registries above, replacing unbounded
@@ -662,6 +847,27 @@ def job_latency_snapshot(job_id: str) -> dict:
     return out
 
 
+def hist_totals_over(
+    kind: str, scope: str, name: str, over_ms: float
+) -> "tuple[int, int]":
+    """(total samples, samples above ``over_ms``) for one registered
+    histogram — (0, 0) when the scope never recorded that metric.  The
+    SLO monitors' probe: cumulative pairs diffed across burn windows,
+    WITHOUT creating registry rows for scopes that carry no traffic."""
+    with _HIST_LOCK:
+        h = _HISTS.get((kind, scope, name))
+    if h is None:
+        return 0, 0
+    return h.totals_over(over_ms)
+
+
+def hist_scopes(kind: str) -> set:
+    """The scope ids that hold at least one histogram of ``kind`` — how
+    the SLO monitors discover live job/tenant instances to evaluate."""
+    with _HIST_LOCK:
+        return {scope for (k, scope, _name) in _HISTS if k == kind}
+
+
 def reset_histograms() -> None:
     """Drop every registered histogram (call before a measurement
     window, read ``hist_snapshot`` after)."""
@@ -681,6 +887,8 @@ def metrics_snapshot() -> dict:
     the server's ``metrics`` verb returns and ``gelly-top`` polls."""
     from gelly_streaming_tpu.utils import tracing
 
+    from gelly_streaming_tpu.utils import events
+
     return {
         "pipeline": pipeline_stats(),
         "comms": comms_stats(),
@@ -692,6 +900,9 @@ def metrics_snapshot() -> dict:
         "tenant_totals": tenant_totals(),
         "histograms": hist_snapshot(),
         "spans": tracing.span_stats(),
+        "health": all_job_health(),
+        "alerts": all_alerts(),
+        "events": events.journal().stats(),
     }
 
 
@@ -711,61 +922,119 @@ def _prom_sanitize(name: str) -> str:
 def render_prometheus(snap: Optional[dict] = None) -> str:
     """Render a metrics snapshot in the Prometheus text exposition format
     (``gelly_``-prefixed): flat counters as gauges, per-job/per-tenant
-    rows as labeled gauges, histograms as real Prometheus histograms
-    (cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``), and the span
-    stage aggregates as labeled totals."""
+    rows and health gauges as labeled gauges, SLO alerts as numeric
+    ``slo_state`` (0=OK 1=WARN 2=PAGE) plus burn-rate gauges, histograms
+    as real Prometheus histograms (cumulative ``_bucket{le=...}`` +
+    ``_sum`` + ``_count``), and the span stage aggregates as labeled
+    totals.
+
+    Samples are grouped by METRIC FAMILY with one ``# HELP``/``# TYPE``
+    header each — the grammar the exposition spec requires (all series of
+    a family contiguous, metadata before samples) and the one the
+    strict-format lint in tests/test_prometheus_lint.py enforces.  The
+    pre-health-plane renderer interleaved a family's job-labeled series
+    between other families' rows, which strict scrapers reject.
+    """
     if snap is None:
         snap = metrics_snapshot()
-    lines: List[str] = []
+    # family name -> {"type", "samples": [(label-str-no-braces, value)]}
+    # or {"type": "histogram", "hists": [(label, snapshot dict)]}; dict
+    # insertion order IS the exposition order
+    fams: dict = {}
 
-    def gauge(name, value, labels=""):
-        lines.append(f"gelly_{_prom_sanitize(name)}{labels} {value}")
+    def add(name, value, label="", mtype="gauge"):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        fam = fams.setdefault(
+            f"gelly_{_prom_sanitize(name)}", {"type": mtype, "samples": []}
+        )
+        fam["samples"].append((label, value))
 
-    for section in ("pipeline", "comms", "wire", "compile_cache"):
+    for section in ("pipeline", "comms", "wire", "compile_cache", "events"):
         for key, val in sorted(snap.get(section, {}).items()):
-            if isinstance(val, (int, float)):
-                gauge(key, val)
-    for scope_key, label in (("jobs", "job"), ("tenants", "tenant")):
-        for sid, row in sorted(snap.get(scope_key, {}).items()):
-            labels = f'{{{label}="{_prom_escape(sid)}"}}'
-            for key, val in sorted(row.items()):
-                if isinstance(val, (int, float)):
-                    gauge(key, val, labels)
+            add(key, val)
+    # labeled rows grouped PER KEY (one family's series stay contiguous)
+    for scope_key, label_name in (
+        ("jobs", "job"),
+        ("tenants", "tenant"),
+        ("health", "job"),
+    ):
+        rows = snap.get(scope_key, {})
+        keys = sorted(
+            {
+                key
+                for row in rows.values()
+                for key, val in row.items()
+                if isinstance(val, (int, float)) and not isinstance(val, bool)
+            }
+        )
+        for key in keys:
+            for sid in sorted(rows):
+                val = rows[sid].get(key)
+                if isinstance(val, bool) or not isinstance(val, (int, float)):
+                    continue
+                add(key, val, f'{label_name}="{_prom_escape(sid)}"')
+    for row in snap.get("alerts", []):
+        label = (
+            f'scope="{_prom_escape(row.get("scope", ""))}",'
+            f'id="{_prom_escape(row.get("id", ""))}",'
+            f'slo="{_prom_escape(row.get("slo", ""))}"'
+        )
+        add("slo_state", ALERT_LEVELS.get(row.get("state"), 0), label)
+        add("slo_burn_fast", row.get("burn_fast", 0.0), label)
+        add("slo_burn_slow", row.get("burn_slow", 0.0), label)
     hists = snap.get("histograms", {})
-    scoped = []
     for name, h in hists.get("global", {}).items():
-        scoped.append((name, "", h))
-    for sid, row in hists.get("jobs", {}).items():
-        for name, h in row.items():
-            scoped.append((name, f'job="{_prom_escape(sid)}"', h))
-    for sid, row in hists.get("tenants", {}).items():
-        for name, h in row.items():
-            scoped.append((name, f'tenant="{_prom_escape(sid)}"', h))
-    ratio = 2.0 ** (1.0 / LatencyHistogram.PER_OCTAVE)
-    for name, label, h in scoped:
-        base = f"gelly_{_prom_sanitize(name)}"
-        cum = 0
-        for lower, count in h.get("buckets", []):
-            cum += count
-            sep = "," if label else ""
-            # le is the bucket's UPPER bound (snapshot stores lowers)
-            lines.append(
-                f'{base}_bucket{{{label}{sep}le="{round(lower * ratio, 6)}"}}'
-                f" {cum}"
-            )
-        sep = "," if label else ""
-        lines.append(f'{base}_bucket{{{label}{sep}le="+Inf"}} {h["count"]}')
-        braces = f"{{{label}}}" if label else ""
-        lines.append(f'{base}_sum{braces} {h["sum_ms"]}')
-        lines.append(f'{base}_count{braces} {h["count"]}')
+        fam = fams.setdefault(
+            f"gelly_{_prom_sanitize(name)}", {"type": "histogram", "hists": []}
+        )
+        fam.setdefault("hists", []).append(("", h))
+    for scope_key, label_name in (("jobs", "job"), ("tenants", "tenant")):
+        for sid, row in sorted(hists.get(scope_key, {}).items()):
+            for name, h in row.items():
+                fam = fams.setdefault(
+                    f"gelly_{_prom_sanitize(name)}",
+                    {"type": "histogram", "hists": []},
+                )
+                fam.setdefault("hists", []).append(
+                    (f'{label_name}="{_prom_escape(sid)}"', h)
+                )
     for plane, stages in snap.get("spans", {}).get("stages", {}).items():
         for stage, cell in sorted(stages.items()):
-            labels = (
-                f'{{plane="{_prom_escape(plane)}",'
-                f'stage="{_prom_escape(stage)}"}}'
+            label = (
+                f'plane="{_prom_escape(plane)}",'
+                f'stage="{_prom_escape(stage)}"'
             )
-            gauge("span_stage_ms_total", cell["total_ms"], labels)
-            gauge("span_stage_count", cell["count"], labels)
+            add("span_stage_ms_total", cell["total_ms"], label)
+            add("span_stage_count", cell["count"], label)
+
+    ratio = 2.0 ** (1.0 / LatencyHistogram.PER_OCTAVE)
+    lines: List[str] = []
+    for fam_name, fam in fams.items():
+        help_text = fam_name[len("gelly_"):].replace("_", " ")
+        lines.append(f"# HELP {fam_name} {help_text}")
+        lines.append(f"# TYPE {fam_name} {fam['type']}")
+        if fam["type"] == "histogram":
+            for label, h in fam.get("hists", []):
+                sep = "," if label else ""
+                cum = 0
+                for lower, count in h.get("buckets", []):
+                    cum += count
+                    # le is the bucket's UPPER bound (snapshot stores lowers)
+                    lines.append(
+                        f'{fam_name}_bucket{{{label}{sep}'
+                        f'le="{round(lower * ratio, 6)}"}} {cum}'
+                    )
+                lines.append(
+                    f'{fam_name}_bucket{{{label}{sep}le="+Inf"}} {h["count"]}'
+                )
+                braces = f"{{{label}}}" if label else ""
+                lines.append(f'{fam_name}_sum{braces} {h["sum_ms"]}')
+                lines.append(f'{fam_name}_count{braces} {h["count"]}')
+        else:
+            for label, value in fam["samples"]:
+                braces = f"{{{label}}}" if label else ""
+                lines.append(f"{fam_name}{braces} {value}")
     return "\n".join(lines) + "\n"
 
 

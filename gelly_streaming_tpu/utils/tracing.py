@@ -129,6 +129,18 @@ class LatencyHistogram:
         with self._lock:
             return self._count
 
+    def totals_over(self, ms: float) -> "tuple[int, int]":
+        """(total samples, samples above ``ms``) in ONE lock acquisition —
+        the SLO monitors' burn-rate probe (runtime/slo.py) diffs these
+        cumulative pairs across its fast/slow windows.  "Above" counts the
+        buckets strictly past the one containing ``ms``, so a threshold on
+        a bucket boundary is exact and any other is an underestimate of at
+        most one bucket width (2^(1/8)-1 ≈ 9%) — the same tolerance the
+        reported quantiles already carry."""
+        i = self.bucket_index(ms)
+        with self._lock:
+            return self._count, sum(self._counts[i + 1:])
+
     def quantile(self, p: float) -> float:
         """Nearest-rank quantile over the buckets: the lower bound of the
         bucket holding the value at 1-based rank ``ceil(p/100 * N)``."""

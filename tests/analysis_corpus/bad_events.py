@@ -1,0 +1,46 @@
+"""Seeded bugs for the health-plane fixtures (ISSUE 10): the event
+journal's '# guarded-by:' ring/cursor/file written without the lock (two
+racing emitters interleave seq bumps — lost or overwritten journal lines,
+exactly the record a post-mortem replay would need), and a device sync
+smuggled into the SLO monitor's evaluation sweep (materializing a gauge
+from a device array blocks the monitor tick on the data plane it is
+supposed to merely observe).
+
+Expected findings: one HOTSYNC, three UNGUARDED.  Analyzer input only —
+never imported.
+"""
+
+import json
+import threading
+
+import numpy as np
+
+_CAP = 1024
+
+
+class EventJournal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = [None] * _CAP  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._file = None  # guarded-by: _lock
+
+    def emit(self, kind, fields):
+        record = {"kind": kind, **fields}
+        self._ring[0] = record  # BUG: racing emitters overwrite the slot
+        self._seq += 1  # BUG: lost-update window on the cursor
+        self._file.write(json.dumps(record) + "\n")  # BUG: races close()
+        return record
+
+
+def monitor_sweep(specs, gauges, clock, evaluate):
+    transitions = []
+    # hot-loop: SLO evaluation sweep (gauge reads + burn math, no syncs)
+    for spec in specs:
+        # BUG: a device-array gauge materialized inline stalls the
+        # monitor tick on the device pipeline it is observing
+        value = float(np.asarray(gauges[spec.key]))
+        t0 = clock()
+        transitions.append(evaluate(spec, value, t0))
+    # hot-loop-end
+    return transitions

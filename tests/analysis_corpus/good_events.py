@@ -1,0 +1,57 @@
+"""Clean counterpart for the health-plane fixtures (ISSUE 10): the event
+journal's ring / cursor / file mirror all live under ONE lock (scheduler,
+connection, and monitor threads emit while the events verb tails), and
+the SLO monitor's evaluation sweep is a '# hot-loop' region of counter
+reads and dict math — a gauge is a host-side Python number by contract,
+never a device value the sweep would have to sync on.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import json
+import threading
+
+_CAP = 1024
+
+
+class EventJournal:
+    """Bounded ring + JSONL mirror: emitters on many threads, tailers on
+    server threads, so every access holds the journal lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = [None] * _CAP  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._file = None  # guarded-by: _lock
+
+    def emit(self, kind, fields):
+        with self._lock:
+            record = {"seq": self._seq, "kind": kind, **fields}
+            self._ring[self._seq % _CAP] = record
+            self._seq += 1
+            if self._file is not None:
+                self._file.write(json.dumps(record) + "\n")
+        return record
+
+    def tail(self, n):
+        with self._lock:
+            end = self._seq
+            return [
+                self._ring[i % _CAP] for i in range(max(0, end - n), end)
+            ]
+
+
+def monitor_sweep(specs, gauges, clock, evaluate):
+    """The SLO monitor's evaluation loop: per tick it reads each spec's
+    gauge, stamps the tick, and feeds the burn-rate state machine —
+    host-side arithmetic only, so the sweep can run at tick rate without
+    ever stalling a data-plane thread."""
+    transitions = []
+    # hot-loop: SLO evaluation sweep (gauge reads + burn math, no syncs)
+    for spec in specs:
+        value = gauges.get(spec.key)
+        t0 = clock()
+        if value is not None:
+            transitions.append(evaluate(spec, value, t0))
+    # hot-loop-end
+    return transitions

@@ -236,6 +236,25 @@ def test_corpus_tracing():
     assert _analyze("good_tracing.py") == []
 
 
+def test_corpus_events():
+    """The health-plane fixtures (ISSUE 10): the event journal's
+    ring/cursor/file mirror are '# guarded-by:' its lock (scheduler,
+    connection, and monitor threads emit while the events verb tails),
+    and the SLO monitor's evaluation sweep is a '# hot-loop' region —
+    gauge reads and burn math only, never a device sync."""
+    findings = _analyze("bad_events.py")
+    assert _codes(findings) == [
+        "HOTSYNC",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+    ]
+    assert any("self._ring" in f.message for f in findings)
+    assert any("self._seq" in f.message for f in findings)
+    assert any("self._file" in f.message for f in findings)
+    assert _analyze("good_events.py") == []
+
+
 def test_corpus_collgather():
     findings = _analyze("bad_collgather.py")
     assert _codes(findings) == ["COLLGATHER", "COLLGATHER", "COLLGATHER"]

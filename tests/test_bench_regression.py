@@ -1,0 +1,133 @@
+"""bench.py --check-regression (ISSUE 10 satellite): fresh bench JSON vs
+the best-so-far per key across the recorded ``BENCH_r*.json`` artifacts.
+
+The properties pinned: direction-aware verdicts (eps regress downward,
+latency/recompiles upward), the configurable tolerance, the absolute
+guard for a 0 lower-better best (recompiles creeping off zero), and the
+``_PARTIAL`` safety contract — keys missing from a partial fresh run or
+from every baseline are SKIP/NEW, never failures, and a torn baseline
+artifact is ignored rather than fatal.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (repo-root module; no jax at import time)
+
+
+def _write(tmp_path, name, doc):
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+@pytest.fixture
+def baselines(tmp_path):
+    # one driver-wrapper artifact, one raw bench line, one torn file
+    _write(
+        tmp_path,
+        "BENCH_r01.json",
+        {
+            "n": 1,
+            "parsed": {
+                "value": 100e6,
+                "e2e_eps": 5e6,
+                "cache_recompiles": 0,
+                "wire_bytes_per_edge": 2.7,
+                "triangle_p50_ms": 40.0,
+                "edges": 1 << 20,  # untracked: no direction rule
+            },
+        },
+    )
+    _write(
+        tmp_path,
+        "BENCH_r02.json",
+        {"value": 120e6, "e2e_eps": 4e6, "triangle_p50_ms": 55.0},
+    )
+    (tmp_path / "BENCH_r03.json").write_text('{"torn')
+    return tmp_path
+
+
+def _check(tmp_path, fresh_doc, tolerance=0.05):
+    fresh = _write(tmp_path, "fresh.json", fresh_doc)
+    return bench.check_regression(
+        fresh, str(tmp_path / "BENCH_r*.json"), tolerance
+    )
+
+
+def test_direction_rules():
+    assert bench._bench_direction("value") == "higher"
+    assert bench._bench_direction("e2e_eps") == "higher"
+    assert bench._bench_direction("async_window_speedup") == "higher"
+    assert bench._bench_direction("wire_compress_ratio") == "higher"
+    assert bench._bench_direction("triangle_p50_ms") == "lower"
+    assert bench._bench_direction("wire_bytes_per_edge") == "lower"
+    assert bench._bench_direction("cache_recompiles") == "lower"
+    assert bench._bench_direction("pipeline_pack_stall_s") == "lower"
+    assert bench._bench_direction("edges") is None
+    assert bench._bench_direction("link_regime") is None
+
+
+def test_fresh_at_best_passes(baselines, capsys):
+    rc = _check(
+        baselines,
+        {
+            "value": 118e6,  # within 5% of the 120e6 best
+            "e2e_eps": 5.2e6,
+            "cache_recompiles": 0,
+            "wire_bytes_per_edge": 2.69,
+            "triangle_p50_ms": 41.0,
+        },
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REGRESS" not in out
+    assert "0 regression(s)" in out
+
+
+def test_higher_better_regression_fails(baselines, capsys):
+    rc = _check(baselines, {"value": 90e6})
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "value" in out and "REGRESS" in out
+
+
+def test_lower_better_regression_fails(baselines, capsys):
+    rc = _check(baselines, {"value": 125e6, "triangle_p50_ms": 70.0})
+    assert rc == 1
+    assert "triangle_p50_ms" in capsys.readouterr().out
+
+
+def test_zero_baseline_recompiles_guarded_absolutely(baselines):
+    # best cache_recompiles is 0: a fresh run at 2 is a regression even
+    # though 2 > 0 * (1 + tol) would otherwise never trip
+    assert _check(baselines, {"cache_recompiles": 2}) == 1
+    assert _check(baselines, {"cache_recompiles": 0}) == 0
+
+
+def test_partial_fresh_skips_never_fails(baselines, capsys):
+    # a device_unavailable partial carries only host-side keys
+    rc = _check(baselines, {"cpu_baseline_eps": 9e7, "device_unavailable": True})
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SKIP" in out and "NEW" in out  # cpu_baseline_eps has no baseline
+
+
+def test_tolerance_is_configurable(baselines):
+    assert _check(baselines, {"value": 100e6}, tolerance=0.05) == 1
+    assert _check(baselines, {"value": 100e6}, tolerance=0.2) == 0
+
+
+def test_untracked_and_nonscalar_keys_ignored(baselines, capsys):
+    rc = _check(
+        baselines,
+        {"edges": 1, "chunks": [1, 2], "link_regime": "healthy"},
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "edges" not in out.split()  # not a tracked row

@@ -1,0 +1,356 @@
+"""Prometheus text-format lint (ISSUE 10 satellite): a strict line-grammar
+validator run over ``render_prometheus()`` output — including the new
+lag/health gauges and SLO alert families.
+
+The exposition format's rules are easy to violate incrementally (a label
+with a raw quote, a family's series interleaved between other families,
+a histogram whose cumulative buckets dip): a scraper then drops the whole
+scrape, which is exactly when the metrics mattered.  ``lint_prometheus``
+enforces:
+
+* line grammar — every line is a ``# HELP``/``# TYPE`` comment or a
+  ``name{labels} value`` sample with legal metric/label names, properly
+  escaped label values (only ``\\\\``, ``\\"``, ``\\n``), and a float value;
+* family grouping + metadata ordering — all samples of a family are
+  contiguous, at most one HELP/TYPE each, and they precede the samples;
+* histogram shape — per series, ``_bucket`` ``le`` values strictly
+  increasing with non-decreasing cumulative counts, a terminal
+  ``le="+Inf"`` bucket equal to ``_count``, and a ``_sum`` present.
+
+The pre-health-plane renderer violated the grouping rule (a family's
+job-labeled series interleaved per job); the rewrite is pinned here.
+"""
+
+import math
+import re
+
+import pytest
+
+from gelly_streaming_tpu.utils import metrics
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# quoted label value: only \\ \" \n escapes are legal
+_LABEL_VALUE_RE = re.compile(r'^(?:[^"\\\n]|\\\\|\\"|\\n)*$')
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*)( .*)?$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$"
+)
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_labels(raw, errors, where):
+    """{'name': 'value'} from the inside of a label brace block."""
+    out = {}
+    if raw is None or raw == "":
+        return out
+    # split on commas outside quotes
+    parts, depth, cur = [], False, ""
+    i = 0
+    while i < len(raw):
+        ch = raw[i]
+        if ch == "\\" and depth and i + 1 < len(raw):
+            cur += raw[i : i + 2]
+            i += 2
+            continue
+        if ch == '"':
+            depth = not depth
+        if ch == "," and not depth:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+        i += 1
+    if cur:
+        parts.append(cur)
+    for part in parts:
+        if "=" not in part:
+            errors.append(f"{where}: malformed label pair {part!r}")
+            continue
+        name, _, value = part.partition("=")
+        if not _LABEL_NAME_RE.match(name):
+            errors.append(f"{where}: bad label name {name!r}")
+        if not (value.startswith('"') and value.endswith('"') and len(value) >= 2):
+            errors.append(f"{where}: unquoted label value {value!r}")
+            continue
+        body = value[1:-1]
+        if not _LABEL_VALUE_RE.match(body):
+            errors.append(f"{where}: bad escaping in label value {body!r}")
+        if name in out:
+            errors.append(f"{where}: duplicate label {name!r}")
+        out[name] = body
+    return out
+
+
+def _value(text, errors, where):
+    if text in ("+Inf", "-Inf", "Nan", "NaN"):
+        return math.inf if text == "+Inf" else -math.inf
+    try:
+        return float(text)
+    except ValueError:
+        errors.append(f"{where}: unparseable sample value {text!r}")
+        return 0.0
+
+
+def lint_prometheus(text):
+    """Validate one exposition; returns a list of error strings ([] = clean)."""
+    errors = []
+    # family name -> list of (sample name, labels dict, value) in order
+    families = {}
+    meta = {}  # family -> {"help": line#, "type": (line#, kind)}
+    order = []  # family order of first appearance (meta or sample)
+    closed = set()
+    typed_hist = set()
+
+    def family_of(name):
+        for suffix in _HIST_SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in typed_hist:
+                return name[: -len(suffix)]
+        return name
+
+    last_family = None
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        where = f"line {lineno}"
+        if not line:
+            errors.append(f"{where}: empty line inside exposition")
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            kind = "help"
+            if m is None:
+                m = _TYPE_RE.match(line)
+                kind = "type"
+            if m is None:
+                errors.append(f"{where}: malformed comment {line!r}")
+                continue
+            fam = m.group(1)
+            if fam not in order:
+                order.append(fam)
+            if fam in closed or fam in families:
+                errors.append(
+                    f"{where}: {kind.upper()} for {fam} after its samples "
+                    "(metadata must precede the family's samples)"
+                )
+            if kind in meta.setdefault(fam, {}):
+                errors.append(f"{where}: duplicate {kind.upper()} for {fam}")
+            meta[fam][kind] = lineno
+            if kind == "type" and m.group(2) == "histogram":
+                typed_hist.add(fam)
+            if last_family is not None and last_family != fam:
+                closed.add(last_family)
+            last_family = fam
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"{where}: malformed sample line {line!r}")
+            continue
+        name, _braced, rawlabels, value_s = m.groups()
+        if not _NAME_RE.match(name):
+            errors.append(f"{where}: bad metric name {name!r}")
+        fam = family_of(name)
+        if fam in closed:
+            errors.append(
+                f"{where}: family {fam} reappears after other families "
+                "(all series of a family must be contiguous)"
+            )
+        if last_family is not None and last_family != fam:
+            closed.add(last_family)
+        last_family = fam
+        if fam not in order:
+            order.append(fam)
+        labels = _parse_labels(rawlabels, errors, where)
+        value = _value(value_s, errors, where)
+        families.setdefault(fam, []).append((name, labels, value))
+
+    for fam in typed_hist:
+        samples = families.get(fam, [])
+        # series key = labels minus le
+        series = {}
+        for name, labels, value in samples:
+            key = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            entry = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if name == f"{fam}_bucket":
+                if "le" not in labels:
+                    errors.append(f"{fam}: bucket sample without le label")
+                    continue
+                le = labels["le"]
+                entry["buckets"].append(
+                    (math.inf if le == "+Inf" else float(le), value)
+                )
+            elif name == f"{fam}_sum":
+                entry["sum"] = value
+            elif name == f"{fam}_count":
+                entry["count"] = value
+            else:
+                errors.append(f"{fam}: stray series {name} in histogram")
+        for key, entry in series.items():
+            les = [le for le, _c in entry["buckets"]]
+            counts = [c for _le, c in entry["buckets"]]
+            if not les or les[-1] != math.inf:
+                errors.append(f"{fam}{dict(key)}: no terminal +Inf bucket")
+            if any(a >= b for a, b in zip(les, les[1:])):
+                errors.append(f"{fam}{dict(key)}: le values not increasing")
+            if any(a > b for a, b in zip(counts, counts[1:])):
+                errors.append(
+                    f"{fam}{dict(key)}: cumulative bucket counts decreased"
+                )
+            if entry["count"] is None or entry["sum"] is None:
+                errors.append(f"{fam}{dict(key)}: missing _sum/_count")
+            elif les and les[-1] == math.inf and counts[-1] != entry["count"]:
+                errors.append(
+                    f"{fam}{dict(key)}: +Inf bucket {counts[-1]} != "
+                    f"_count {entry['count']}"
+                )
+    return errors
+
+
+def _populated_snapshot():
+    """Exercise every family shape the renderer emits: counters, job and
+    tenant rows, health gauges, alerts, multi-scope histograms, spans."""
+    metrics.reset_histograms()
+    metrics.reset_job_health()
+    metrics.reset_alerts()
+    metrics.reset_job_stats()
+    for ms in (0.5, 2.0, 8.0, 33.0):
+        metrics.hist_record(
+            "window_close_to_emission_ms", ms, job='t/esc"job\n', tenant="t"
+        )
+    metrics.hist_record("submit_to_first_emission_ms", 12.0, job="t/j2")
+    metrics.job_add('t/esc"job\n', "job_records", 4)
+    metrics.job_add("t/j2", "job_dispatches", 2)
+    metrics.tenant_add("t", "tenant_requests", 7)
+    metrics.job_health_update(
+        't/esc"job\n',
+        {
+            "watermark_lag_windows": 3,
+            "backlog_batches": 5,
+            "backlog_age_s": 1.25,
+            "arrival_eps": 1000.0,
+            "drain_eps": 400.0,
+            "keepup_ratio": 0.4,
+            "time_to_queue_full_s": 9.5,
+        },
+    )
+    metrics.alert_set(
+        "job",
+        't/esc"job\n',
+        "max_backlog_age_s",
+        {
+            "state": "WARN",
+            "burn_fast": 1.5,
+            "burn_slow": 1.2,
+            "threshold": 1.0,
+        },
+    )
+    snap = metrics.metrics_snapshot()
+    metrics.reset_histograms()
+    metrics.reset_job_health()
+    metrics.reset_alerts()
+    metrics.reset_job_stats()
+    return snap
+
+
+def test_render_prometheus_passes_strict_lint():
+    snap = _populated_snapshot()
+    text = metrics.render_prometheus(snap)
+    assert lint_prometheus(text) == []
+    # the new health-plane families made it into the exposition
+    assert "gelly_watermark_lag_windows" in text
+    assert "gelly_backlog_age_s" in text
+    assert "gelly_keepup_ratio" in text
+    assert "gelly_slo_state" in text and "} 1" in text  # WARN -> 1
+    # escaped label values survived the round trip
+    assert '\\"' in text and "\\n" in text
+
+
+def test_render_prometheus_groups_families_and_types():
+    text = metrics.render_prometheus(_populated_snapshot())
+    lines = text.splitlines()
+    # every family has TYPE before its first sample
+    seen_sample = set()
+    for line in lines:
+        if line.startswith("# TYPE "):
+            fam = line.split()[2]
+            assert fam not in seen_sample, f"TYPE after samples for {fam}"
+        elif line and not line.startswith("#"):
+            name = line.split("{")[0].split(" ")[0]
+            seen_sample.add(name)
+    # the multi-scope histogram family is one contiguous block
+    idx = [
+        i
+        for i, l in enumerate(lines)
+        if l.startswith("gelly_window_close_to_emission_ms")
+    ]
+    assert idx and idx == list(range(idx[0], idx[-1] + 1))
+
+
+@pytest.mark.parametrize(
+    "bad,needle",
+    [
+        # TYPE after the family's samples
+        (
+            "gelly_x 1\n# TYPE gelly_x gauge\n",
+            "after its samples",
+        ),
+        # family interleaved
+        (
+            "# TYPE gelly_a gauge\ngelly_a 1\n# TYPE gelly_b gauge\n"
+            "gelly_b 1\ngelly_a 2\n",
+            "must be contiguous",
+        ),
+        # raw quote in a label value
+        (
+            '# TYPE gelly_a gauge\ngelly_a{job="a"b"} 1\n',
+            "label",
+        ),
+        # non-increasing le
+        (
+            "# TYPE gelly_h histogram\n"
+            'gelly_h_bucket{le="1.0"} 1\ngelly_h_bucket{le="1.0"} 2\n'
+            'gelly_h_bucket{le="+Inf"} 2\ngelly_h_sum 3\ngelly_h_count 2\n',
+            "not increasing",
+        ),
+        # cumulative counts decreased
+        (
+            "# TYPE gelly_h histogram\n"
+            'gelly_h_bucket{le="1.0"} 3\ngelly_h_bucket{le="2.0"} 2\n'
+            'gelly_h_bucket{le="+Inf"} 3\ngelly_h_sum 3\ngelly_h_count 3\n',
+            "decreased",
+        ),
+        # missing terminal +Inf
+        (
+            "# TYPE gelly_h histogram\n"
+            'gelly_h_bucket{le="1.0"} 1\ngelly_h_sum 1\ngelly_h_count 1\n',
+            "+Inf",
+        ),
+        # +Inf bucket != _count
+        (
+            "# TYPE gelly_h histogram\n"
+            'gelly_h_bucket{le="+Inf"} 2\ngelly_h_sum 1\ngelly_h_count 3\n',
+            "_count",
+        ),
+        # bad metric name
+        ("# TYPE gelly_a gauge\n9bad 1\n", "malformed sample"),
+        # duplicate TYPE
+        (
+            "# TYPE gelly_a gauge\n# TYPE gelly_a gauge\ngelly_a 1\n",
+            "duplicate TYPE",
+        ),
+    ],
+)
+def test_lint_catches_seeded_violations(bad, needle):
+    errors = lint_prometheus(bad)
+    assert errors, f"lint missed: {bad!r}"
+    assert any(needle in e for e in errors), (needle, errors)
+
+
+def test_lint_is_strict_about_line_grammar():
+    assert lint_prometheus("# HELLO gelly_a x\n") != []
+    assert lint_prometheus("# TYPE gelly_a flavor\n") != []
+    assert lint_prometheus("# TYPE gelly_a gauge\ngelly_a one\n") != []

@@ -332,7 +332,14 @@ def test_metrics_snapshot_shape_and_prometheus_render():
         assert key in snap
     text = metrics.render_prometheus(snap)
     lines = text.splitlines()
-    assert all(l.startswith("gelly_") for l in lines if l)
+    # samples are gelly_-prefixed; HELP/TYPE metadata lines ride above
+    # each family (the strict-format contract tests/test_prometheus_lint
+    # pins in full)
+    assert all(
+        l.startswith(("gelly_", "# HELP gelly_", "# TYPE gelly_"))
+        for l in lines
+        if l
+    )
     # histogram series: cumulative buckets end at +Inf == count
     inf = [l for l in lines if 'le="+Inf"' in l and "sched_queue_wait" in l]
     assert inf and inf[0].endswith(" 1")
