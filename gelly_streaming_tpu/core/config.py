@@ -311,6 +311,74 @@ class SLOSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Policy knobs for the elastic control plane (runtime/autoscale.py).
+
+    The autoscaler turns the health plane's verdicts into geometry
+    decisions: a job whose SLO alert has sat at PAGE for ``page_hold``
+    consecutive policy evaluations is drained and resubmitted at
+    ``factor``x its shard count (up to ``max_shards``); a job that has
+    been over-provisioned-idle (keep-up ratio at/above ``idle_keepup``
+    with an empty backlog and no burning alert) for ``idle_hold``
+    evaluations shrinks by the same factor (down to ``min_shards``),
+    returning ``max_state_bytes`` budget headroom to admission.
+
+    Hysteresis comes in two layers: the burn-rate state machine
+    (OK -> WARN -> PAGE with clear-hold, runtime/slo.py) gates what counts
+    as "burning" at all, and the streak/hold counters here demand the
+    verdict be SUSTAINED across evaluations — a single paged tick never
+    moves a shard.  ``cooldown_s`` then keeps a freshly rescaled job from
+    flapping: its streaks restart and no new decision fires until the
+    quiet period elapses.
+
+    Attributes:
+      factor: geometric step per decision (2 = double / halve).
+      min_shards: floor for scale-down decisions.
+      max_shards: ceiling for scale-up decisions; 0 defers entirely to the
+        actuator's own eligibility check (device count, capacity
+        divisibility), which always applies.
+      page_hold: consecutive policy evaluations a job-scope alert must sit
+        at PAGE before a scale-up fires.
+      idle_hold: consecutive idle evaluations before a scale-down fires.
+      idle_keepup: keep-up ratio at/above which a backlog-free job counts
+        as over-provisioned (drain rate >= this multiple of arrivals).
+      cooldown_s: per-job quiet period after a rescale (or a failed one —
+        a failing actuator must not be retried at tick rate).
+      interval_s: seconds between policy evaluations.
+    """
+
+    factor: int = 2
+    min_shards: int = 1
+    max_shards: int = 0
+    page_hold: int = 3
+    idle_hold: int = 10
+    idle_keepup: float = 4.0
+    cooldown_s: float = 30.0
+    interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.factor < 2:
+            raise ValueError("autoscale factor must be >= 2")
+        if self.min_shards < 1:
+            raise ValueError("min_shards must be >= 1")
+        if self.max_shards < 0:
+            raise ValueError("max_shards must be >= 0 (0 = actuator-bound)")
+        if self.max_shards and self.max_shards < self.min_shards:
+            raise ValueError("max_shards must be >= min_shards")
+        if self.page_hold < 1 or self.idle_hold < 1:
+            raise ValueError("page_hold/idle_hold must be >= 1 evaluation")
+        if self.idle_keepup <= 1.0:
+            raise ValueError(
+                "idle_keepup must be > 1.0 (a job merely keeping up is "
+                "not over-provisioned)"
+            )
+        if self.cooldown_s < 0:
+            raise ValueError("cooldown_s must be >= 0")
+        if self.interval_s <= 0:
+            raise ValueError("autoscale interval_s must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
 class RuntimeConfig:
     """Knobs for the multi-tenant job runtime (runtime/manager.py).
 
@@ -352,6 +420,13 @@ class RuntimeConfig:
         data planes.
       slo_interval_s: seconds between SLO monitor evaluations (each one
         reads histogram/gauge registries and updates the alert rows).
+      autoscale: the elastic control plane switch (runtime/autoscale.py).
+        1 starts the scaling-policy thread alongside the scheduler, 0
+        forces it off, -1 (default) defers to the ``GELLY_AUTOSCALE`` env
+        var, defaulting OFF — the passive health plane stays exactly what
+        it was unless an operator closes the loop explicitly.
+      autoscale_policy: the :class:`AutoscalePolicy` thresholds the policy
+        thread evaluates (holds, factor, cooldown, interval).
     """
 
     max_jobs: int = 8
@@ -362,6 +437,8 @@ class RuntimeConfig:
     health_sample_s: float = 1.0
     slos: tuple = ()
     slo_interval_s: float = 0.5
+    autoscale: int = -1
+    autoscale_policy: AutoscalePolicy = AutoscalePolicy()
 
     def __post_init__(self):
         if self.max_jobs <= 0:
@@ -380,6 +457,10 @@ class RuntimeConfig:
             raise ValueError("slo_interval_s must be positive")
         if not all(isinstance(s, SLOSpec) for s in self.slos):
             raise ValueError("slos must be a tuple of SLOSpec")
+        if self.autoscale not in (-1, 0, 1):
+            raise ValueError("autoscale must be -1 (auto), 0, or 1")
+        if not isinstance(self.autoscale_policy, AutoscalePolicy):
+            raise ValueError("autoscale_policy must be an AutoscalePolicy")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -44,6 +44,64 @@ from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
 from gelly_streaming_tpu.utils.envswitch import resolve_switch
 
 
+def reshard_summary(blocks, cfg, old_num_shards: int, new_num_shards: int):
+    """Re-route owner-sharded summary blocks into a new shard geometry.
+
+    ``blocks`` is a spec's block pytree — every array leaf laid out
+    ``[old_S, C/old_S, ...]`` under the modulo ownership every
+    ``shard_summary`` in the tree uses (vertex ``g`` at row
+    ``(g % S, g // S)``) — and the result is the SAME pytree re-blocked
+    ``[new_S, C/new_S, ...]``.  This is the elastic control plane's state
+    re-route (runtime/autoscale.py): a drained job's persistent blocks
+    move to the 2x (or half) geometry without a device in the loop.
+
+    Bit-exact by construction: each leaf is unsharded through its
+    replicated ``[C, ...]`` view (the ``shard_summary`` inverse — the same
+    reindexing ``unshard_labels`` does for CC label blocks) and re-blocked
+    with the identical ``reshape(-1, S).swapaxes`` rule ``shard_summary``
+    itself applies, so for any spec
+    ``reshard_summary(spec.shard_summary(x, cfg, a), cfg, a, b)
+    == spec.shard_summary(x, cfg, b)`` holds leaf-for-leaf — pinned by
+    tests/test_sharded_state.py's round-trip oracles.
+
+    Pure host reindexing (no device, no collective): both geometries are
+    modulo-sharded, so the move is two reshapes per leaf, O(C) bytes.
+    """
+    import numpy as np
+
+    old_s, new_s = int(old_num_shards), int(new_num_shards)
+    cap = cfg.vertex_capacity
+    for name, s in (("old", old_s), ("new", new_s)):
+        if s <= 0:
+            raise ValueError(f"{name} shard count must be positive, got {s}")
+        if cap % s:
+            raise ValueError(
+                f"vertex_capacity ({cap}) must be divisible by the {name} "
+                f"shard count ({s}) for even re-sharding"
+            )
+
+    def leaf(a):
+        a = np.asarray(a)
+        if a.ndim < 2 or a.shape[0] != old_s or a.shape[0] * a.shape[1] != cap:
+            raise ValueError(
+                f"block leaf shape {a.shape} does not match the "
+                f"[{old_s}, {cap // old_s}, ...] owner-block layout"
+            )
+        # shard_summary inverse: full[g] = blocks[g % S, g // S]
+        full = np.ascontiguousarray(np.swapaxes(a, 0, 1)).reshape(
+            (cap,) + a.shape[2:]
+        )
+        # and shard_summary forward at the new geometry
+        reblocked = np.swapaxes(
+            full.reshape((cap // new_s, new_s) + a.shape[2:]), 0, 1
+        )
+        return np.ascontiguousarray(reblocked)
+
+    import jax
+
+    return jax.tree.map(leaf, blocks)
+
+
 def resolve_sharded_state(cfg) -> bool:
     """Effective sharded-state switch: config > env > on.
 

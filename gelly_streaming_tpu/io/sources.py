@@ -184,6 +184,23 @@ class SourceQuiesced(RuntimeError):
     """
 
 
+class PushOutOfSync(RuntimeError):
+    """Push refused because its declared stream offset does not match the
+    source's position.
+
+    The live-rescale hole this closes (ISSUE 11): a pipelined push written
+    BEFORE the client learned of a drain/rescale can reach the server
+    AFTER the swap installed the job's new source — at face value a valid
+    push, but positionally it belongs to the OLD stream, and accepting it
+    at the new source's cursor would silently shift every replayed pane
+    boundary.  Clients that stamp each frame with its global edge offset
+    (``GellyClient.push_edges`` does) get exact positional verification;
+    a mismatch is this typed refusal, and re-pushing from the advertised
+    cursor (whose offsets then match) is the recovery — the same
+    at-least-once overlap the drain contract already pins.
+    """
+
+
 class NetworkEdgeSource:
     """Feed a running job's record source from client-pushed wire batches.
 
@@ -291,7 +308,13 @@ class NetworkEdgeSource:
             if self._closed:
                 raise SourceQuiesced("source is closed (end-of-stream seen)")
 
-    def push_wire(self, buf, width, timeout: Optional[float] = None) -> int:
+    def push_wire(
+        self,
+        buf,
+        width,
+        timeout: Optional[float] = None,
+        offset: Optional[int] = None,
+    ) -> int:
         """Validate + decode one full wire buffer and queue its batch.
 
         ``width`` is an io/wire encoding (fixed byte width or the
@@ -299,7 +322,11 @@ class NetworkEdgeSource:
         ``self.batch`` edges.  Blocks while the queue is full (the
         per-connection backpressure); raises ``queue.Full`` only when
         ``timeout`` elapses, ``ValueError`` on a buffer failing the
-        ``from_wire`` guards, ``SourceQuiesced`` during/after drain.
+        ``from_wire`` guards, ``SourceQuiesced`` during/after drain,
+        ``PushOutOfSync`` when ``offset`` (the batch's declared global
+        edge position, resume filler included) does not match the
+        source's accepted-edge count — the positional guard that keeps a
+        stale pipelined push from landing past a live rescale's cursor.
         Returns the number of edges accepted.
         """
         from gelly_streaming_tpu.core.stream import (
@@ -316,12 +343,19 @@ class NetworkEdgeSource:
             self.cfg.vertex_capacity,
             decode_ids=True,
         )
-        self._accept(s, d, timeout)
+        self._accept(s, d, timeout, offset)
         return len(s)
 
-    def push_tail(self, src, dst, timeout: Optional[float] = None) -> int:
+    def push_tail(
+        self,
+        src,
+        dst,
+        timeout: Optional[float] = None,
+        offset: Optional[int] = None,
+    ) -> int:
         """Queue a raw partial batch (the stream remainder shorter than one
-        wire buffer) — same id-bounds contract as ``from_wire``'s tail."""
+        wire buffer) — same id-bounds contract as ``from_wire``'s tail,
+        same optional positional guard as ``push_wire``."""
         self._refuse_if_not_open()
         src = np.asarray(src)
         dst = np.asarray(dst)
@@ -344,10 +378,29 @@ class NetworkEdgeSource:
             )
         s = np.ascontiguousarray(src, dtype=np.int32)
         d = np.ascontiguousarray(dst, dtype=np.int32)
-        self._accept(s, d, timeout)
+        self._accept(s, d, timeout, offset)
         return len(s)
 
-    def _accept(self, s, d, timeout: Optional[float]) -> None:
+    def _check_offset(self, offset: Optional[int]) -> None:
+        if offset is None:
+            return
+        with self._lock:
+            expect = self._edges_in
+        if int(offset) != expect:
+            raise PushOutOfSync(
+                f"push declares edge offset {int(offset)} but this source "
+                f"is at {expect} accepted edges (resume filler included): "
+                "the batch belongs to a stream position this source does "
+                "not hold — re-push from the advertised resume cursor"
+            )
+
+    def _accept(self, s, d, timeout: Optional[float], offset=None) -> None:
+        # positional guard first: a stale pipelined frame must refuse, not
+        # wait on (or worse, land in) a queue it has no position in.  The
+        # check re-runs on blocked-push retries (the server's bounded-wait
+        # slices), so the window between check and put stays harmless for
+        # the one-pusher-per-job contract the accounting assumes.
+        self._check_offset(offset)
         # enqueue timestamp: the consumer side records queue residency as
         # the push-to-fold latency histogram (how long a pushed batch
         # waited before the scheduler folded it)
@@ -357,6 +410,22 @@ class NetworkEdgeSource:
         wake = self.on_data
         if wake is not None:
             wake()
+
+    @property
+    def draining(self) -> bool:
+        """True while the source is quiesced for a drain/rescale (pushes
+        are being refused ``SourceQuiesced``); False once closed normally
+        or while open."""
+        with self._lock:
+            return self._quiesced and not self._closed
+
+    def resume_pushes(self) -> None:
+        """Reopen a quiesced (not closed) source — the rescale's FAILURE
+        path: the drain did not complete, the job keeps running at its
+        old geometry, and its clients must be able to keep pushing
+        instead of being told to await a restart that never comes."""
+        with self._lock:
+            self._quiesced = False
 
     def close(self) -> None:
         """Mark end-of-stream: queued batches drain, then the job's source

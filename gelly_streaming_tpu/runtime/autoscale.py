@@ -1,0 +1,402 @@
+"""Elastic control plane: health-driven live re-sharding (ISSUE 11).
+
+The health plane (runtime/slo.py + the scheduler's gauge sampler) produces
+exactly the signals an autoscaler needs — keep-up ratio, backlog age,
+watermark lag, OK -> WARN -> PAGE burn state — and the serving plane built
+the actuation primitives: drain flushes in-flight windows through the
+normal GeneratorExit completion-queue path and leaves a checkpoint-derived
+resume cursor, and a resubmitted job restores bit-exactly from it at ANY
+shard geometry (``shard_summary`` takes the shard count; see also
+``core/sharded_state.reshard_summary`` for the device-free block
+re-route).  This module closes the loop:
+
+* a POLICY THREAD (started with the scheduler like ``SLOMonitor`` when
+  ``RuntimeConfig.autoscale`` / ``GELLY_AUTOSCALE`` enables it; injectable
+  clock, deterministic ``evaluate_once`` for tests) sweeps the registered
+  jobs each ``AutoscalePolicy.interval_s``;
+* a job whose job-scope SLO alert has sat at PAGE for ``page_hold``
+  consecutive sweeps is scaled UP: drained and resubmitted at ``factor``x
+  its shard count from its resume cursor;
+* a job that has been over-provisioned-idle (keep-up ratio at/above
+  ``idle_keepup`` with an empty backlog and no burning alert) for
+  ``idle_hold`` sweeps is scaled DOWN, freeing ``max_state_bytes`` budget
+  for admission to accept more tenants;
+* every decision and outcome is a structured journal event
+  (``scale_decision`` / ``scale_done`` / ``scale_failed``) and a live
+  desired-vs-actual gauge row (utils.metrics ``job_scale_update``), so the
+  whole chain replays from the JSONL journal and shows in gelly-top's
+  SCALE column.
+
+The autoscaler owns POLICY only; ACTUATION is delegated to registered
+handles (duck-typed — see :class:`RescaleTarget`), because only the layer
+that built a job can rebuild it at a new geometry.  The serving plane
+registers one handle per eligible push-source job
+(runtime/server.py ``_ServedRescaleTarget``): its rescale rides the
+existing quiesce -> cancel-flush -> checkpoint-cursor -> resubmit path,
+with the admitted state bytes re-priced ATOMICALLY through the manager's
+swap reservation (``JobManager.begin_rescale``) so no concurrent tenant
+can steal the budget mid-swap and the old and new footprints are never
+both counted.
+
+Threading: the handle registry and per-job decision state are written by
+registration callers (server connection threads) and the policy thread at
+once, so both live under the autoscaler's one lock — the graftcheck
+corpus pair tests/analysis_corpus/{good,bad}_autoscale.py pins the
+discipline.  The decision sweep itself reads host-side registries only
+(alert rows, health gauges — plain Python numbers by contract): it can
+never sync the device or block a data-plane thread.  Actuation happens on
+the policy thread OUTSIDE the lock — a drain legitimately takes seconds,
+and registration must never wait on it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from gelly_streaming_tpu.core.config import AutoscalePolicy
+from gelly_streaming_tpu.utils import events, metrics
+from gelly_streaming_tpu.utils.envswitch import resolve_switch
+
+#: terminal job states (mirrors runtime/job.py JobState.TERMINAL without
+#: importing the job module into the policy layer)
+_TERMINAL = frozenset({"DONE", "FAILED", "CANCELLED"})
+
+
+def resolve_autoscale(cfg) -> bool:
+    """Effective autoscale switch: config > env > OFF.
+
+    ``cfg.autoscale``: 1 forces on, 0 forces off, -1 (default) defers to
+    the ``GELLY_AUTOSCALE`` env var, defaulting OFF — closing the control
+    loop is an operator decision, never ambient.
+    """
+    return resolve_switch(
+        getattr(cfg, "autoscale", -1), "GELLY_AUTOSCALE", default=False
+    )
+
+
+class RescaleTarget:
+    """The actuation contract a registered handle satisfies (duck-typed;
+    subclassing is optional).  Every method must be thread-safe: the
+    policy thread calls them while the owning layer serves traffic.
+
+    * ``job_state()`` — the managed job's current lifecycle state string
+      (``"RUNNING"``, ...); terminal states retire the registration.
+    * ``current_shards()`` — the geometry the job runs at now.
+    * ``eligible(num_shards)`` — whether this job CAN run at that
+      geometry (capacity divisibility, device count, checkpointability);
+      consulted before every decision, so policy bounds and actuator
+      bounds compose.
+    * ``rescale(num_shards, reason)`` — perform the move: drain, re-route
+      state, resubmit from the resume cursor.  Returns a dict merged into
+      the ``scale_done`` journal event (e.g. ``resume_edges``); raises to
+      record ``scale_failed`` (the job then cools down, never retried at
+      tick rate).
+    """
+
+    def job_state(self) -> str:
+        raise NotImplementedError
+
+    def current_shards(self) -> int:
+        raise NotImplementedError
+
+    def eligible(self, num_shards: int) -> bool:
+        raise NotImplementedError
+
+    def rescale(self, num_shards: int, reason: str) -> dict:
+        raise NotImplementedError
+
+
+class _JobPolicyState:
+    """Per-job streak/cooldown bookkeeping (see the module lock note)."""
+
+    __slots__ = ("page_streak", "idle_streak", "cooldown_until", "rescales")
+
+    def __init__(self):
+        self.page_streak = 0
+        self.idle_streak = 0
+        self.cooldown_until = 0.0
+        self.rescales = 0
+
+
+class Autoscaler:
+    """The scaling-policy thread over the health/alert registries.
+
+    ``evaluate_once(now)`` is the public deterministic unit (tests drive
+    it with scripted clocks and fake handles); ``start()`` runs it on a
+    daemon thread every ``policy.interval_s`` seconds.  Jobs register via
+    :meth:`register` and retire automatically when their job goes
+    terminal outside a rescale.
+    """
+
+    def __init__(
+        self,
+        policy: Optional[AutoscalePolicy] = None,
+        clock=time.monotonic,
+        journal: Optional[events.EventJournal] = None,
+    ):
+        self.policy = policy or AutoscalePolicy()
+        if not isinstance(self.policy, AutoscalePolicy):
+            raise TypeError(f"not an AutoscalePolicy: {policy!r}")
+        self._clock = clock
+        self._journal = journal
+        self._lock = threading.Lock()
+        self._handles: Dict[str, RescaleTarget] = {}  # guarded-by: _lock
+        self._states: Dict[str, _JobPolicyState] = {}  # guarded-by: _lock
+        self.evaluations = 0  # single-thread: autoscale policy
+        self.rescales = 0  # single-thread: autoscale policy
+        self.failures = 0  # single-thread: autoscale policy
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, job_id: str, handle: RescaleTarget) -> None:
+        """Put a job under management; its scale gauge row appears at once
+        (desired == actual == the current geometry), so a freshly admitted
+        job is visible in gelly-top's SCALE column before the first sweep.
+        Re-registering a job id replaces the handle and resets streaks."""
+        shards = int(handle.current_shards())
+        with self._lock:
+            self._handles[job_id] = handle
+            self._states[job_id] = _JobPolicyState()
+        metrics.job_scale_update(
+            job_id,
+            {
+                "desired_shards": shards,
+                "actual_shards": shards,
+                "rescales": 0,
+                "last_reason": "",
+            },
+        )
+
+    def unregister(self, job_id: str) -> None:
+        """Retire a job from management and drop its scale gauge row."""
+        with self._lock:
+            self._handles.pop(job_id, None)
+            self._states.pop(job_id, None)
+        metrics.drop_job_scale(job_id)
+
+    def managed(self) -> List[str]:
+        with self._lock:
+            return sorted(self._handles)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="gelly-autoscaler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _loop(self) -> None:  # single-thread: autoscale policy
+        while not self._stop.wait(self.policy.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                # a policy bug must cost a sweep, never the thread that
+                # exists to react to exactly such degradations
+                continue
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _state_for(self, job_id: str) -> Optional[_JobPolicyState]:
+        with self._lock:
+            return self._states.get(job_id)
+
+    def evaluate_once(self, now: Optional[float] = None) -> List[dict]:
+        """One policy sweep; returns the decisions it ACTED on (each also
+        journaled and reflected in the scale gauge rows).  Decisions are
+        computed from host-side registry reads only; actuations run here
+        on the calling (policy) thread, outside the registry lock."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            handles = dict(self._handles)
+        decisions: List[dict] = []
+        retired: List[str] = []
+        # hot-loop: autoscale decision sweep (alert/gauge registry reads +
+        # streak math only — never a device sync, never a blocking call)
+        for job_id in sorted(handles):
+            handle = handles[job_id]
+            try:
+                state = handle.job_state()
+                if state in _TERMINAL:
+                    # finished outside a rescale: retire the registration
+                    retired.append(job_id)
+                    continue
+                if state != "RUNNING":
+                    continue  # paused/pending/draining jobs hold position
+                decision = self._evaluate_job(job_id, handle, now)
+            except Exception:
+                continue  # one broken handle must not abort the sweep
+            if decision is not None:
+                decisions.append(decision)
+        # hot-loop-end
+        for job_id in retired:
+            self.unregister(job_id)
+        out = []
+        for decision in decisions:
+            out.append(self._actuate(decision, handles[decision["job"]], now))
+        self.evaluations += 1
+        return out
+
+    def _evaluate_job(
+        self, job_id: str, handle: RescaleTarget, now: float
+    ) -> Optional[dict]:
+        """Streak accounting + the decision rule for one job; returns the
+        decision dict or None.  Host registry reads only."""
+        pol = self.policy
+        st = self._state_for(job_id)
+        if st is None:
+            return None  # raced an unregister
+        cur = int(handle.current_shards())
+        alerts = metrics.alerts_for("job", job_id)
+        paging = any(a.get("state") == "PAGE" for a in alerts)
+        burning = any(a.get("state") in ("WARN", "PAGE") for a in alerts)
+        health = metrics.job_health(job_id)
+        if paging:
+            st.page_streak += 1
+            st.idle_streak = 0
+        else:
+            st.page_streak = 0
+            idle = (
+                not burning
+                and health.get("keepup_ratio", 0.0) >= pol.idle_keepup
+                and health.get("backlog_batches", 0) == 0
+                and health.get("watermark_lag_windows", 0) == 0
+            )
+            st.idle_streak = st.idle_streak + 1 if idle else 0
+        desired, reason, trigger = cur, None, None
+        if now >= st.cooldown_until:
+            if st.page_streak >= pol.page_hold:
+                target = cur * pol.factor
+                if pol.max_shards:
+                    target = min(target, pol.max_shards)
+                if target > cur and handle.eligible(target):
+                    desired, reason = target, "page-burn"
+                    trigger = max(
+                        (a.get("burn_fast", 0.0) for a in alerts
+                         if a.get("state") == "PAGE"),
+                        default=0.0,
+                    )
+            elif st.idle_streak >= pol.idle_hold:
+                target = max(cur // pol.factor, pol.min_shards)
+                if target < cur and handle.eligible(target):
+                    desired, reason = target, "idle"
+                    trigger = health.get("keepup_ratio")
+        # the live desired-vs-actual gauges: updated EVERY sweep so a
+        # pending/failed actuation is visible as desired != actual
+        metrics.job_scale_update(
+            job_id,
+            {
+                "actual_shards": cur,
+                "desired_shards": desired,
+                "page_streak": st.page_streak,
+                "idle_streak": st.idle_streak,
+            },
+        )
+        if reason is None:
+            return None
+        st.page_streak = 0
+        st.idle_streak = 0
+        # cooldown starts at DECISION time: a failing actuator is not
+        # retried at tick rate, and a fresh geometry gets its quiet period
+        st.cooldown_until = now + pol.cooldown_s
+        return {
+            "job": job_id,
+            "reason": reason,
+            "direction": "up" if desired > cur else "down",
+            "old_shards": cur,
+            "new_shards": desired,
+            "trigger": round(float(trigger), 4) if trigger is not None else None,
+        }
+
+    def _actuate(self, decision: dict, handle: RescaleTarget, now: float) -> dict:
+        """Run one decision through its handle; journal both ends."""
+        journal = self._journal or events.journal()
+        journal.emit("scale_decision", **decision)
+        job_id = decision["job"]
+        t0 = time.perf_counter()
+        try:
+            res = handle.rescale(decision["new_shards"], decision["reason"]) or {}
+        except Exception as e:
+            self.failures += 1
+            journal.emit(
+                "scale_failed",
+                job=job_id,
+                old_shards=decision["old_shards"],
+                new_shards=decision["new_shards"],
+                error=repr(e),
+            )
+            # give up on this decision: desired snaps back so the gauge
+            # row doesn't advertise a geometry nobody is moving toward
+            # (the cooldown set at decision time spaces any retry)
+            metrics.job_scale_update(
+                job_id,
+                {
+                    "desired_shards": decision["old_shards"],
+                    "last_reason": f"failed:{decision['reason']}",
+                },
+            )
+            return dict(decision, ok=False, error=repr(e))
+        downtime_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self.rescales += 1
+        st = self._state_for(job_id)
+        rescales = 0
+        if st is not None:
+            st.rescales += 1
+            rescales = st.rescales
+        done = dict(
+            decision,
+            ok=True,
+            downtime_ms=downtime_ms,
+            resume_edges=res.get("resume_edges"),
+        )
+        journal.emit(
+            "scale_done",
+            job=job_id,
+            reason=decision["reason"],
+            old_shards=decision["old_shards"],
+            new_shards=decision["new_shards"],
+            downtime_ms=downtime_ms,
+            resume_edges=res.get("resume_edges"),
+        )
+        metrics.job_scale_update(
+            job_id,
+            {
+                "actual_shards": decision["new_shards"],
+                "desired_shards": decision["new_shards"],
+                "last_reason": decision["reason"],
+                "last_downtime_ms": downtime_ms,
+                "rescales": rescales,
+            },
+        )
+        return done
+
+    def stats(self) -> dict:
+        with self._lock:
+            managed = len(self._handles)
+        return {
+            "managed_jobs": managed,
+            "evaluations": self.evaluations,
+            "rescales": self.rescales,
+            "failures": self.failures,
+            "interval_s": self.policy.interval_s,
+            "running": self._thread is not None and self._thread.is_alive(),
+        }

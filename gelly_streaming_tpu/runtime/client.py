@@ -123,13 +123,15 @@ class GellyClient:
             np.ascontiguousarray(buf, np.uint8).tobytes(),
         )[0]
 
-    def push_tail(self, job: str, src, dst) -> dict:
+    def push_tail(
+        self, job: str, src, dst, offset: Optional[int] = None
+    ) -> dict:
         src = np.ascontiguousarray(src, "<i4")
         dst = np.ascontiguousarray(dst, "<i4")
-        return self.call(
-            {"verb": "push", "job": job, "kind": "tail", "count": len(src)},
-            src.tobytes() + dst.tobytes(),
-        )[0]
+        header = {"verb": "push", "job": job, "kind": "tail", "count": len(src)}
+        if offset is not None:
+            header["offset"] = int(offset)
+        return self.call(header, src.tobytes() + dst.tobytes())[0]
 
     def eos(self, job: str) -> dict:
         return self.call({"verb": "eos", "job": job})[0]
@@ -145,6 +147,8 @@ class GellyClient:
         start: int = 0,
         close: bool = True,
         window: int = 32,
+        position: Optional[int] = None,
+        declare_position: bool = True,
     ) -> int:
         """Pack ``src/dst[start:]`` into full wire batches (+ raw tail) and
         push them, optionally closing the stream.  Returns edges pushed.
@@ -152,17 +156,34 @@ class GellyClient:
         ``start`` is the resume cursor from ``submit`` — on reconnect the
         client ships only the suffix the server's checkpoint doesn't cover.
 
+        ``position`` is the GLOBAL stream offset of ``src[start]`` when it
+        differs from ``start`` itself — the incremental pattern, where each
+        call pushes a fresh chunk (``start=0``) of a stream whose earlier
+        edges went in previous calls: pass the count pushed so far.
+        ``declare_position=False`` drops the offset stamps entirely (the
+        server's legacy unchecked behavior) for callers that cannot know
+        their position.
+
         Push frames are PIPELINED: up to ``window`` frames are written
         before their replies are read (replies come back in order — the
         server handles one connection's frames sequentially), so the
         socket round trip is paid once per window, not once per batch,
         while the bounded reply window still surfaces refusals promptly
         and keeps the server's per-connection backpressure effective.
+
+        Every frame is stamped with its global edge ``offset`` (``start +
+        batches pushed so far``), which the server verifies against the
+        source's exact positional accounting — so a frame still in flight
+        when a live rescale/drain swaps the job's source is refused
+        ``out-of-sync`` instead of silently landing past the new resume
+        cursor.  On a ``quiesced``/``out-of-sync`` refusal, re-push from
+        the advertised cursor.
         """
         from gelly_streaming_tpu.io import wire as wire_mod
 
         src = np.ascontiguousarray(src, np.int32)[start:]
         dst = np.ascontiguousarray(dst, np.int32)[start:]
+        base = int(position) if position is not None else start
         width = wire_mod.width_for_capacity(capacity)
         n_full = len(src) // batch
         outstanding = 0
@@ -193,6 +214,8 @@ class GellyClient:
                 else:
                     head = {"verb": "push", "job": job, "kind": "wire"}
                     buf = wire_mod.pack_edges(s_b, d_b, width)
+                if declare_position:
+                    head["offset"] = base + i * batch
                 head["token"] = self.token
                 protocol.write_frame(self._f, head, np.ascontiguousarray(buf))
                 outstanding += 1
@@ -209,7 +232,12 @@ class GellyClient:
         if refusal is not None:
             raise refusal
         if len(src) % batch:
-            self.push_tail(job, src[n_full * batch :], dst[n_full * batch :])
+            self.push_tail(
+                job,
+                src[n_full * batch :],
+                dst[n_full * batch :],
+                offset=base + n_full * batch if declare_position else None,
+            )
         if close:
             self.eos(job)
         return len(src)

@@ -60,6 +60,16 @@ class JobManager:
         self._lock = threading.RLock()
         self._jobs: Dict[str, Job] = {}  # guarded-by: _lock
         self._admitted_bytes = 0  # guarded-by: _lock
+        # state bytes held OUT of the open pool by in-flight rescale swaps
+        # (begin_rescale moves a draining job's budget here, priced at the
+        # NEW geometry; submit(reserved_bytes=...) consumes it) — counted
+        # against max_state_bytes by every admission check, so a
+        # concurrent tenant can never steal a swap's budget mid-drain
+        self._reserved_bytes = 0  # guarded-by: _lock
+        # job SLOTS held the same way: mid-swap the draining job reads
+        # terminal, so without this a concurrent submit could fill
+        # max_jobs during the drain and strand the resubmit
+        self._reserved_jobs = 0  # guarded-by: _lock
         self._seq = itertools.count()
         self._stop = False  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
@@ -75,6 +85,9 @@ class JobManager:
         # SLO burn-rate monitor (runtime/slo.py): started with the
         # scheduler when cfg.slos is non-empty, stopped at shutdown
         self._slo_monitor = None  # guarded-by: _lock
+        # elastic control plane (runtime/autoscale.py): started with the
+        # scheduler when cfg.autoscale / GELLY_AUTOSCALE resolves on
+        self._autoscaler = None  # guarded-by: _lock
 
     # -- submission ----------------------------------------------------------
 
@@ -91,6 +104,7 @@ class JobManager:
         edges_hint: Optional[int] = None,
         ready: Optional[Callable[[], bool]] = None,
         progress: Optional[Callable[[], dict]] = None,
+        reserved_bytes: Optional[int] = None,
     ) -> Job:
         """Admit a query whose ``build()`` returns a fresh records iterator
         (the ``OutputStream`` contract: ``iter(stream.aggregate(...))``).
@@ -112,30 +126,59 @@ class JobManager:
         a probe returning the source's progress dict (see
         ``NetworkEdgeSource.progress``) for the health plane's keep-up
         gauges; jobs without one still get sink-side gauges.
+
+        ``reserved_bytes`` (None = a normal submit): this is a rescale
+        RESUBMIT — consume that many bytes of an in-flight swap
+        reservation (``begin_rescale``) plus the job slot it holds,
+        instead of fresh budget.  Must not exceed the outstanding
+        reservation.
         """
         state_bytes = int(state_bytes)
+        swap_submit = reserved_bytes is not None
+        reserved_bytes = int(reserved_bytes or 0)
         with self._lock:
             if self._stop:
                 raise RuntimeError("JobManager is shut down")
+            if reserved_bytes < 0 or reserved_bytes > self._reserved_bytes:
+                raise ValueError(
+                    f"reserved_bytes ({reserved_bytes}) exceeds the "
+                    f"outstanding swap reservation ({self._reserved_bytes})"
+                )
+            if swap_submit and self._reserved_jobs < 1:
+                raise ValueError(
+                    "reserved_bytes passed without an outstanding rescale "
+                    "slot (begin_rescale reserves one per swap)"
+                )
             active = [
                 j
                 for j in self._jobs.values()
                 if not j._state_in(*JobState.TERMINAL)
             ]
-            if len(active) >= self.cfg.max_jobs:
+            # in-flight swaps hold their job slots; a swap's own resubmit
+            # consumes (exactly) the slot its begin_rescale reserved
+            slots_held = len(active) + self._reserved_jobs - (
+                1 if swap_submit else 0
+            )
+            if slots_held >= self.cfg.max_jobs:
                 self._reject(
                     name,
-                    f"job cap reached: {len(active)} active jobs >= "
+                    f"job cap reached: {len(active)} active + "
+                    f"{self._reserved_jobs} rescaling jobs >= "
                     f"max_jobs={self.cfg.max_jobs}",
                 )
+            # swap reservations count as committed: the open pool is
+            # admitted + reserved, and a rescale submit's own reservation
+            # covers (exactly) that much of its price
+            committed = self._admitted_bytes + self._reserved_bytes
             if (
                 self.cfg.max_state_bytes
-                and self._admitted_bytes + state_bytes
+                and committed + state_bytes - reserved_bytes
                 > self.cfg.max_state_bytes
             ):
                 self._reject(
                     name,
                     f"state-byte cap reached: {self._admitted_bytes} admitted"
+                    f" + {self._reserved_bytes} reserved"
                     f" + {state_bytes} requested > "
                     f"max_state_bytes={self.cfg.max_state_bytes}",
                 )
@@ -175,6 +218,9 @@ class JobManager:
             job._manager = self
             self._jobs[job_id] = job
             self._admitted_bytes += state_bytes
+            self._reserved_bytes -= reserved_bytes
+            if swap_submit:
+                self._reserved_jobs -= 1
             # journal the submit BEFORE the scheduler can run the job: the
             # scheduler's PENDING->RUNNING transition must get a later seq
             # than job_submitted or replay's lifecycle chain breaks (the
@@ -235,6 +281,96 @@ class JobManager:
             edges_per_record=edges_per_record,
             edges_hint=stream.num_edges_hint(),
         )
+
+    # -- rescale budget swap (the elastic control plane, ISSUE 11) -----------
+
+    def begin_rescale(self, job: Job, new_state_bytes: int) -> int:
+        """Atomically move a live job's admitted budget into a swap
+        reservation priced at its NEW geometry — step one of a live
+        re-shard's re-pricing (runtime/autoscale.py).
+
+        Under the ONE admission lock: the job's held bytes leave the
+        admitted pool (its later terminal release returns nothing — the
+        budget moved, it was not freed) and ``new_state_bytes`` enter the
+        reservation, which every admission check counts as committed.  So
+        across the whole drain -> resubmit window there is no instant
+        where the old and new footprints are both charged (no 2x
+        double-book) and no instant where a concurrent tenant can grab
+        the freed budget (no steal).  Growth beyond the held bytes is
+        admission-checked here; rejection raises ``AdmissionError`` and
+        leaves the job exactly as it was.
+
+        Returns the reserved byte count — pass it to
+        ``submit(reserved_bytes=...)`` to consume, or to
+        ``abort_rescale`` to return it to the pool if the swap dies.
+        """
+        new_state_bytes = int(new_state_bytes)
+        if new_state_bytes < 0:
+            raise ValueError("new_state_bytes must be >= 0")
+        with self._lock:
+            held = job.state_bytes
+            grow = new_state_bytes - held
+            if (
+                self.cfg.max_state_bytes
+                and grow > 0
+                and self._admitted_bytes + self._reserved_bytes + grow
+                > self.cfg.max_state_bytes
+            ):
+                self._reject(
+                    job.job_id,
+                    f"rescale re-pricing needs {grow} more state bytes: "
+                    f"{self._admitted_bytes} admitted + "
+                    f"{self._reserved_bytes} reserved + {grow} > "
+                    f"max_state_bytes={self.cfg.max_state_bytes}",
+                )
+            self._admitted_bytes -= held
+            job.state_bytes = 0  # its release now returns nothing
+            self._reserved_bytes += new_state_bytes
+            # the job SLOT is reserved too: the drain makes this job
+            # terminal mid-swap, and a concurrent submit filling max_jobs
+            # during it would strand the resubmit
+            self._reserved_jobs += 1
+        return new_state_bytes
+
+    def abort_rescale(
+        self,
+        reserved_bytes: int,
+        job: Optional[Job] = None,
+        restore_state_bytes: int = 0,
+    ) -> None:
+        """Return an unconsumed swap reservation (bytes + job slot) to the
+        open pool — the drain or resubmit failed and budget must not leak
+        out of circulation.
+
+        ``job``/``restore_state_bytes``: when the DRAIN itself failed (the
+        cancel timed out and the job is still live), re-charge the job's
+        original bytes out of the freed reservation — a running job whose
+        ``state_bytes`` stayed zeroed would let admission stack a second
+        full job on top of its live summary state.  A job that did reach a
+        terminal state restores nothing (its budget is correctly free).
+        """
+        with self._lock:
+            self._reserved_bytes = max(
+                0, self._reserved_bytes - int(reserved_bytes)
+            )
+            self._reserved_jobs = max(0, self._reserved_jobs - 1)
+            if (
+                job is not None
+                and restore_state_bytes
+                and not job._state_in(*JobState.TERMINAL)
+            ):
+                job.state_bytes = int(restore_state_bytes)
+                self._admitted_bytes += int(restore_state_bytes)
+        self._wake.set()
+
+    @property
+    def autoscaler(self):
+        """The elastic control plane's policy thread, or None when
+        ``RuntimeConfig.autoscale`` / ``GELLY_AUTOSCALE`` left it off (or
+        no job has started the scheduler yet).  The serving plane
+        registers its rescale handles here."""
+        with self._lock:
+            return self._autoscaler
 
     def _evict_old_terminal(self) -> None:
         """Bound the terminal-job history to ``keep_terminal_jobs`` (oldest
@@ -318,6 +454,7 @@ class JobManager:
         with self._lock:
             jobs = dict(self._jobs)
             admitted = self._admitted_bytes
+            reserved = self._reserved_bytes
             dumps = {
                 job_id: job._trace_dump for job_id, job in jobs.items()
             }
@@ -339,6 +476,9 @@ class JobManager:
             health = metrics.job_health(job_id)
             if health:
                 row["health"] = health
+            scale = metrics.job_scale(job_id)
+            if scale:
+                row["scale"] = scale
             alerts = metrics.alerts_for("job", job_id)
             if alerts:
                 row["alerts"] = alerts
@@ -350,6 +490,7 @@ class JobManager:
         return {
             "jobs": out,
             "admitted_state_bytes": admitted,
+            "reserved_state_bytes": reserved,
             "totals": metrics.job_totals(),
         }
 
@@ -389,9 +530,13 @@ class JobManager:
             thread = self._thread
             monitor = self._slo_monitor
             self._slo_monitor = None
+            autoscaler = self._autoscaler
+            self._autoscaler = None
         self._wake.set()
         if monitor is not None:
             monitor.stop()
+        if autoscaler is not None:
+            autoscaler.stop()
         if thread is not None:
             thread.join(timeout)
 
@@ -420,6 +565,15 @@ class JobManager:
                     self.cfg.slos, interval_s=self.cfg.slo_interval_s
                 )
                 self._slo_monitor.start()
+            if self._autoscaler is None:
+                from gelly_streaming_tpu.runtime.autoscale import (
+                    Autoscaler,
+                    resolve_autoscale,
+                )
+
+                if resolve_autoscale(self.cfg):
+                    self._autoscaler = Autoscaler(self.cfg.autoscale_policy)
+                    self._autoscaler.start()
 
     def _start_sink_thread(self, job: Job) -> None:
         """Per-job sink pump: drains the bounded queue into the sink on its

@@ -183,12 +183,24 @@ def main(argv=None) -> int:
             path=conf["events_path"],
             max_bytes=int(conf.get("events_max_bytes", 4 << 20)),
         )
+    # elastic control plane (ISSUE 11): "autoscale": 1 starts the scaling
+    # policy thread (or leave -1 and set GELLY_AUTOSCALE); the optional
+    # "autoscale_policy" object carries AutoscalePolicy knob overrides
+    from gelly_streaming_tpu.core.config import AutoscalePolicy
+
+    try:
+        policy = AutoscalePolicy(**conf.get("autoscale_policy", {}))
+    except (TypeError, ValueError) as e:
+        print(f"bad autoscale_policy config: {e}", file=sys.stderr)
+        return 2
     rt_cfg = RuntimeConfig(
         max_jobs=int(conf.get("max_jobs", max(8, len(specs)))),
         max_state_bytes=int(conf.get("max_state_bytes", 0)),
         health_sample_s=float(conf.get("health_sample_s", 1.0)),
         slos=slos,
         slo_interval_s=float(conf.get("slo_interval_s", 0.5)),
+        autoscale=int(conf.get("autoscale", -1)),
+        autoscale_policy=policy,
     )
 
     def sink(rec):

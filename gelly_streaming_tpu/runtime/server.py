@@ -322,6 +322,45 @@ class _ServedJob:
             self._cond.notify_all()
 
 
+class _ServedRescaleTarget:
+    """One served push-job's actuation handle for the elastic control
+    plane (runtime/autoscale.py ``RescaleTarget`` contract): policy lives
+    in the autoscaler, the mechanics — quiesce, drain-flush, cursor,
+    resubmit at the new geometry — live here, because only the serving
+    plane can rebuild this job's source and spec."""
+
+    def __init__(self, server: "StreamServer", sj: "_ServedJob"):
+        self._server = server
+        self._sj = sj
+
+    def job_state(self) -> str:
+        job = self._sj.job
+        return job.state if job is not None else JobState.PENDING
+
+    def current_shards(self) -> int:
+        return self._sj.cfg.num_shards
+
+    def eligible(self, num_shards: int) -> bool:
+        """Geometry feasibility for THIS job: an even vertex split and a
+        mesh the process can actually build (more shards than devices
+        would silently fall back to single-chip partitioning — legal, but
+        not the scale-out the decision meant to buy)."""
+        import jax
+
+        sj = self._sj
+        return (
+            num_shards >= 1
+            and sj.source is not None
+            and bool(sj.checkpoint_path)
+            and bool(sj.cfg.ingest_window_edges)
+            and sj.cfg.vertex_capacity % num_shards == 0
+            and num_shards <= len(jax.devices())
+        )
+
+    def rescale(self, num_shards: int, reason: str) -> dict:
+        return self._server._rescale_served(self._sj, num_shards, reason)
+
+
 class StreamServer:
     """The long-lived network frontend over one ``JobManager``.
 
@@ -359,8 +398,16 @@ class StreamServer:
         # serializes tenant-cap check -> manager.submit -> registration:
         # two concurrent submits must not both pass a tenant's job/byte cap
         # before either registers (the check-then-act race the corpus pair
-        # pins for the connection registry, applied to admission)
-        self._admission = threading.Lock()
+        # pins for the connection registry, applied to admission).
+        # Re-entrant: the rescale path holds it across helper calls that
+        # take it again for their own guarded accesses.
+        self._admission = threading.RLock()
+        # per-tenant in-flight rescale swaps: while a job drains for a
+        # rescale its manager-side bytes live in a reservation and the old
+        # job reads terminal/zero-byte, so the tenant-cap arithmetic below
+        # would see a vacancy a concurrent submit could steal — these
+        # figures keep the swap counted against the TENANT's caps too
+        self._tenant_swaps: Dict[str, dict] = {}  # guarded-by: _admission
         self._stop = threading.Event()
         self._shutdown_requested = threading.Event()
         self._sock: Optional[socket.socket] = None
@@ -672,28 +719,15 @@ class StreamServer:
 
         resume_edges = 0
         w = cfg.ingest_window_edges
-        if checkpoint_path and source_kind == "push" and w:
-            # the drain/restart cursor: how many whole windows the job's
-            # positional checkpoint already covers (the same snapshot the
-            # merge loop skips by on replay — consistent by construction)
-            last_window, _gdone = descriptor._restored_position(
-                cfg, checkpoint_path, True
-            )
-            resume_edges = (last_window + 1) * w
+        if source_kind == "push":
+            resume_edges = self._resume_cursor(descriptor, cfg, checkpoint_path)
 
-        from gelly_streaming_tpu.io.sources import NetworkEdgeSource
         from gelly_streaming_tpu.io.wire import BDV_MAX_ID_BITS
 
         source = None
         if source_kind == "push":
             try:
-                source = NetworkEdgeSource(
-                    cfg,
-                    cfg.batch_size,
-                    resume_edges=resume_edges,
-                    max_queued_batches=self.cfg.ingest_queue_batches,
-                    on_data=self.manager.poke,
-                )
+                source = self._make_push_source(cfg, resume_edges)
             except ValueError as e:
                 raise _Refused("bad-spec", str(e))
         sj = _ServedJob(
@@ -716,21 +750,9 @@ class StreamServer:
             self._admit_tenant(tenant, state_bytes)
             try:
                 if source is not None:
-                    build = lambda: iter(  # noqa: E731 — OutputStream contract
-                        source.stream().aggregate(
-                            descriptor, checkpoint_path=checkpoint_path
-                        )
-                    )
-                    job = self.manager.submit(
-                        build,
-                        name=key,
-                        sink=sj.sink,
-                        weight=weight * tenant.weight,
-                        checkpoint_path=checkpoint_path,
-                        state_bytes=state_bytes,
-                        edges_per_record=w or 0,
-                        ready=source.ready,
-                        progress=source.progress,
+                    job = self._submit_push_job(
+                        key, sj, cfg, source, weight * tenant.weight,
+                        state_bytes,
                     )
                 else:
                     job = self.manager.submit_aggregation(
@@ -752,6 +774,13 @@ class StreamServer:
                 self._jobs[key] = sj
         if old is not None:
             old.abandon()  # a terminal predecessor's buffered records go
+        scaler = self.manager.autoscaler
+        if scaler is not None and source is not None and checkpoint_path and w:
+            # elastic control plane: put the job under management — the
+            # policy thread can now drain + resubmit it at a new shard
+            # geometry (push-source + checkpoint + ingest windows are the
+            # preconditions a cursor-exact rescale needs)
+            scaler.register(key, _ServedRescaleTarget(self, sj))
         metrics.tenant_add(tenant.tenant, "tenant_jobs_submitted", 1)
         if resume_edges:
             # the journal's restart-cursor record: a resumed job's replay
@@ -782,9 +811,17 @@ class StreamServer:
 
     def _admit_tenant(self, tenant: TenantConfig, new_state_bytes: int) -> None:
         """Per-tenant admission on top of the manager's global caps; caller
-        gets a typed refusal, the counters get the rejection."""
+        holds ``_admission`` and gets a typed refusal, the counters get the
+        rejection.  In-flight rescale swaps count as held jobs/bytes: the
+        draining job reads terminal/zero-byte mid-swap, but its budget is
+        coming right back at the new geometry — a concurrent submit must
+        not steal the vacancy (the manager-level reservation's guarantee,
+        applied to the tenant caps)."""
         if not (tenant.max_jobs or tenant.max_state_bytes):
             return
+        with self._admission:
+            row = self._tenant_swaps.get(tenant.tenant)
+            swaps = dict(row) if row else {"jobs": 0, "bytes": 0}
         with self._lock:
             live = [
                 sj
@@ -793,14 +830,15 @@ class StreamServer:
                 and sj.job is not None
                 and not sj.job._state_in(*JobState.TERMINAL)
             ]
-        if tenant.max_jobs and len(live) >= tenant.max_jobs:
+        live_count = len(live) + swaps["jobs"]
+        if tenant.max_jobs and live_count >= tenant.max_jobs:
             self._reject_tenant(
                 tenant,
-                f"tenant job cap reached: {len(live)} live jobs >= "
-                f"max_jobs={tenant.max_jobs}",
+                f"tenant job cap reached: {live_count} live/rescaling jobs "
+                f">= max_jobs={tenant.max_jobs}",
             )
         if tenant.max_state_bytes:
-            held = sum(sj.job.state_bytes for sj in live)
+            held = sum(sj.job.state_bytes for sj in live) + swaps["bytes"]
             if held + new_state_bytes > tenant.max_state_bytes:
                 self._reject_tenant(
                     tenant,
@@ -808,6 +846,26 @@ class StreamServer:
                     f"{new_state_bytes} requested > "
                     f"max_state_bytes={tenant.max_state_bytes}",
                 )
+
+    def _tenant_swap_begin(self, tenant_id: str, nbytes: int) -> None:
+        """Count one in-flight rescale against the tenant's caps."""
+        with self._admission:
+            sw = self._tenant_swaps.setdefault(
+                tenant_id, {"jobs": 0, "bytes": 0}
+            )
+            sw["jobs"] += 1
+            sw["bytes"] += nbytes
+
+    def _tenant_swap_end(self, tenant_id: str, nbytes: int) -> None:
+        """Release one in-flight rescale's tenant-cap figures."""
+        with self._admission:
+            sw = self._tenant_swaps.get(tenant_id)
+            if sw is None:
+                return
+            sw["jobs"] = max(0, sw["jobs"] - 1)
+            sw["bytes"] = max(0, sw["bytes"] - nbytes)
+            if sw["jobs"] == 0 and sw["bytes"] == 0:
+                del self._tenant_swaps[tenant_id]
 
     @staticmethod
     def _reject_tenant(tenant: TenantConfig, msg: str) -> None:
@@ -834,13 +892,23 @@ class StreamServer:
                 metrics.tenant_add(tenant.tenant, "tenant_throttle_s", sleep_s)
                 time.sleep(sleep_s)
         from gelly_streaming_tpu.io import wire as wire_mod
-        from gelly_streaming_tpu.io.sources import SourceQuiesced
+        from gelly_streaming_tpu.io.sources import (
+            PushOutOfSync,
+            SourceQuiesced,
+        )
 
+        # optional positional declaration: the frame's global edge offset
+        # (resume filler included).  Stamped by GellyClient.push_edges;
+        # verified against the source's exact accounting so a stale
+        # pipelined frame can never land past a live rescale's cursor.
+        offset = header.get("offset")
+        if offset is not None and not isinstance(offset, int):
+            raise _Refused("bad-spec", "push 'offset' must be an integer")
         buf = np.frombuffer(payload, np.uint8)
         try:
             if kind == "wire":
                 width = wire_mod.width_for_capacity(sj.cfg.vertex_capacity)
-                n = self._push_with_backpressure(sj, buf, width)
+                n = self._push_with_backpressure(sj, buf, width, offset=offset)
             elif kind == "bdv":
                 if not sj.accept_bdv:
                     raise _Refused(
@@ -849,7 +917,7 @@ class StreamServer:
                         "(order-sensitive query or capacity > 2^28)",
                     )
                 width = (wire_mod.BDV, sj.cfg.vertex_capacity)
-                n = self._push_with_backpressure(sj, buf, width)
+                n = self._push_with_backpressure(sj, buf, width, offset=offset)
             elif kind == "tail":
                 count = int(header.get("count", -1))
                 ids = np.frombuffer(payload, "<i4")
@@ -859,12 +927,21 @@ class StreamServer:
                         f"{count} needs exactly {2 * max(count, 0)}"
                     )
                 n = self._push_with_backpressure(
-                    sj, None, None, tail=(ids[:count], ids[count:])
+                    sj,
+                    None,
+                    None,
+                    tail=(ids[:count], ids[count:]),
+                    offset=offset,
                 )
             else:
                 raise _Refused(
                     "bad-spec", f"unknown push kind {kind!r} (wire/bdv/tail)"
                 )
+        except PushOutOfSync as e:
+            # positionally stale (raced a rescale/drain): the client
+            # re-syncs from the cursor; the connection survives
+            metrics.tenant_add(tenant.tenant, "tenant_ingest_rejects", 1)
+            return protocol.error_reply(str(e), code="out-of-sync"), b"", False
         except ValueError as e:
             # a well-formed frame carrying a bad wire buffer: refuse the
             # BUFFER, keep the connection (the client can correct and go on)
@@ -891,7 +968,9 @@ class StreamServer:
             False,
         )
 
-    def _push_with_backpressure(self, sj: _ServedJob, buf, width, tail=None) -> int:
+    def _push_with_backpressure(
+        self, sj: _ServedJob, buf, width, tail=None, offset=None
+    ) -> int:
         """Blocking push with bounded waits: a full ingest queue
         backpressures this connection (the client's TCP window fills
         behind us), but a server stop — or the job reaching a terminal
@@ -900,16 +979,35 @@ class StreamServer:
         forever-wedged connection."""
         import queue as _queue
 
+        # bind the source for the WHOLE push: a live rescale swaps
+        # sj.source mid-flight, and a batch that was blocked on the old
+        # (quiesced) queue must NOT retry into the new source — it would
+        # land ahead of the resume cursor and shift every replayed pane
+        # boundary.  The client re-pushes it from the cursor instead.
+        source = sj.source
         while True:
             try:
                 # 0.25 s slices re-validate on retry — negligible next to
                 # the wait itself, and only paid when the queue is full
                 if tail is not None:
-                    return sj.source.push_tail(*tail, timeout=0.25)
-                return sj.source.push_wire(buf, width, timeout=0.25)
+                    return source.push_tail(*tail, timeout=0.25, offset=offset)
+                return source.push_wire(buf, width, timeout=0.25, offset=offset)
             except _queue.Full:
                 if self._stop.is_set():
                     raise _Refused("shutting-down", "server is stopping")
+                if sj.source is not source or source.draining:
+                    # a rescale/drain owns this source now: the typed
+                    # quiesced refusal (not "terminal — stop pushing") is
+                    # what tells the client the job is coming back and
+                    # everything past the cursor is its to re-push.  The
+                    # swap window makes the old job transiently terminal,
+                    # so this check must come first.
+                    from gelly_streaming_tpu.io.sources import SourceQuiesced
+
+                    raise SourceQuiesced(
+                        f"job {sj.name!r} is draining for a rescale/drain: "
+                        "re-push everything past the resume cursor"
+                    )
                 job = sj.job
                 if job is not None and job._state_in(*JobState.TERMINAL):
                     raise _Refused(
@@ -991,6 +1089,10 @@ class StreamServer:
                 row.get("state_bytes", 0) for row in rows.values()
             ),
         )
+        # the global swap-reservation figure would disclose other
+        # tenants' in-flight rescales — same rule as the recomputed
+        # totals above
+        status.pop("reserved_state_bytes", None)
         with self._lock:
             n_conns = len(self._conns)
             n_jobs = sum(
@@ -1043,6 +1145,11 @@ class StreamServer:
         snap["health"] = {
             k: v
             for k, v in snap.get("health", {}).items()
+            if k.startswith(prefix)
+        }
+        snap["scale"] = {
+            k: v
+            for k, v in snap.get("scale", {}).items()
             if k.startswith(prefix)
         }
         snap["alerts"] = [
@@ -1102,6 +1209,7 @@ class StreamServer:
         ]
         with self.manager._lock:
             monitor = self.manager._slo_monitor
+        scaler = self.manager.autoscaler
         reply = {
             "ok": True,
             "health": {
@@ -1109,6 +1217,12 @@ class StreamServer:
                 "alerts": alerts,
                 "slos": [_dc.asdict(s) for s in self.manager.cfg.slos],
                 "monitor": monitor.stats() if monitor is not None else None,
+                "scale": {
+                    k: v
+                    for k, v in metrics.all_job_scale().items()
+                    if k.startswith(prefix)
+                },
+                "autoscaler": scaler.stats() if scaler is not None else None,
             },
         }
         return reply, b"", False
@@ -1205,6 +1319,160 @@ class StreamServer:
         return self._lifecycle(
             tenant, header, lambda job: self.manager.cancel(job, wait=True)
         )
+
+    def _resume_cursor(self, descriptor, cfg, checkpoint_path) -> int:
+        """The drain/restart/rescale cursor: how many whole windows the
+        job's positional checkpoint already covers (the same snapshot the
+        merge loop skips by on replay — consistent by construction)."""
+        w = cfg.ingest_window_edges
+        if not (checkpoint_path and w):
+            return 0
+        last_window, _gdone = descriptor._restored_position(
+            cfg, checkpoint_path, True
+        )
+        return (last_window + 1) * w
+
+    def _make_push_source(self, cfg, resume_edges: int):
+        from gelly_streaming_tpu.io.sources import NetworkEdgeSource
+
+        return NetworkEdgeSource(
+            cfg,
+            cfg.batch_size,
+            resume_edges=resume_edges,
+            max_queued_batches=self.cfg.ingest_queue_batches,
+            on_data=self.manager.poke,
+        )
+
+    def _submit_push_job(
+        self,
+        key: str,
+        sj: _ServedJob,
+        cfg: StreamConfig,
+        source,
+        weight: int,
+        state_bytes: int,
+        reserved_bytes: "int | None" = None,
+    ) -> Job:
+        """The ONE (re)submit recipe for a push-source job — shared by the
+        submit verb and the rescale actuator, so the wiring (build
+        closure, readiness/progress probes, per-record edge accounting)
+        cannot drift between the two paths.  ``cfg``/``source`` are
+        explicit because a rescale submits the NEW geometry before
+        swapping them into ``sj``."""
+        build = lambda: iter(  # noqa: E731 — OutputStream contract
+            source.stream().aggregate(
+                sj.descriptor, checkpoint_path=sj.checkpoint_path
+            )
+        )
+        return self.manager.submit(
+            build,
+            name=key,
+            sink=sj.sink,
+            weight=weight,
+            checkpoint_path=sj.checkpoint_path,
+            state_bytes=state_bytes,
+            edges_per_record=cfg.ingest_window_edges or 0,
+            ready=source.ready,
+            progress=source.progress,
+            reserved_bytes=reserved_bytes,
+        )
+
+    def _rescale_served(self, sj: _ServedJob, new_shards: int, reason: str) -> dict:
+        """Live re-shard one served push job (the autoscaler's actuator).
+
+        Rides the drain verb's exact machinery end to end: quiesce the
+        source (further pushes refused ``quiesced`` — the client's
+        pipelined-push refusal drain handles the rejection cleanly),
+        cancel through the GeneratorExit completion-queue flush, read the
+        resume cursor back from the positional checkpoint, then resubmit
+        the SAME job name at the new geometry from that cursor — the
+        restore re-routes the checkpointed summary into the new owner
+        blocks via the spec's ``shard_summary`` at the new shard count
+        (core/sharded_state.py), so the resumed fold is bit-exact and
+        emissions across the rescale are overlap-only.
+
+        The admitted state bytes are re-priced ATOMICALLY: the old job's
+        budget moves into a manager swap reservation BEFORE the drain
+        (``begin_rescale``) and the resubmit consumes it
+        (``reserved_bytes=``), so no concurrent tenant can steal the
+        budget mid-swap and the two geometries are never double-booked.
+        Buffered emission records survive (at-least-once: they were
+        emitted past their windows' checkpoint saves).
+        """
+        import dataclasses as _dc
+
+        key = self._job_key_for(sj)
+        old_job = sj.job
+        if old_job is None:
+            raise RuntimeError(f"job {key!r} was never submitted")
+        new_cfg = _dc.replace(sj.cfg, num_shards=int(new_shards))
+        new_state_bytes = sj.descriptor.state_nbytes(new_cfg)
+        old_held = old_job.state_bytes
+        # budget swap begins UNDER the admission lock: the manager-side
+        # reservation (global cap + job slot) and the tenant-swap figures
+        # (per-tenant caps) move together, so no concurrent submit — this
+        # tenant's or anyone's — can steal the draining job's slot or
+        # bytes mid-swap
+        with self._admission:
+            reserved = self.manager.begin_rescale(old_job, new_state_bytes)
+            self._tenant_swap_begin(sj.tenant, new_state_bytes)
+        try:
+            # the drain runs OUTSIDE the admission lock (a cancel flush
+            # legitimately takes seconds; other tenants keep submitting)
+            if sj.source is not None:
+                sj.source.quiesce()
+            if not old_job._state_in(*JobState.TERMINAL):
+                if not self.manager.cancel(old_job, wait=True, timeout=120.0):
+                    # the flush outlived the timeout: the job is STILL
+                    # LIVE — proceeding would resubmit a duplicate name
+                    # against a running job.  Abort; the except path
+                    # restores its budget and reopens its source.
+                    raise RuntimeError(
+                        f"drain of {key!r} did not complete within 120s; "
+                        "rescale aborted, job left running"
+                    )
+            resume_edges = self._resume_cursor(
+                sj.descriptor, new_cfg, sj.checkpoint_path
+            )
+            source = self._make_push_source(new_cfg, resume_edges)
+            with self._admission:
+                job = self._submit_push_job(
+                    key, sj, new_cfg, source, old_job.weight,
+                    new_state_bytes, reserved_bytes=reserved,
+                )
+                # consume the tenant-swap figures in the same hold that
+                # makes the new job live (and visible to _admit_tenant)
+                self._tenant_swap_end(sj.tenant, new_state_bytes)
+                with self._lock:
+                    sj.cfg = new_cfg
+                    sj.source = source
+                    sj.job = job
+        except BaseException:
+            # the swap died (drain timeout, admission surprise): both
+            # reservations go back to their pools, and a job whose drain
+            # never completed gets its budget re-charged and its source
+            # reopened — it is still running and its clients must not be
+            # stranded awaiting a restart that will never come
+            self.manager.abort_rescale(
+                reserved, job=old_job, restore_state_bytes=old_held
+            )
+            with self._admission:
+                self._tenant_swap_end(sj.tenant, new_state_bytes)
+            if sj.source is not None and not old_job._state_in(
+                *JobState.TERMINAL
+            ):
+                sj.source.resume_pushes()
+            raise
+        events.journal().emit(
+            "restart_cursor",
+            job=key,
+            tenant=sj.tenant,
+            resume_edges=resume_edges,
+        )
+        return {"resume_edges": resume_edges, "state_bytes": new_state_bytes}
+
+    def _job_key_for(self, sj: _ServedJob) -> str:
+        return f"{sj.tenant}/{sj.name}"
 
     def _h_drain(self, tenant, header, payload):
         """Graceful drain: quiesce sources, flush in-flight windows through

@@ -54,6 +54,22 @@ def _alert_badge(alerts: list, job_id: Optional[str] = None) -> str:
     return "-"
 
 
+def _scale_cell(scale_rows: dict, job_id: str) -> str:
+    """Compact SCALE cell: ``actual/desired`` shards plus the last scale
+    reason (``2/2 page-burn``); ``->`` marks a pending/failed actuation
+    (desired != actual); ``-`` for jobs outside elastic management."""
+    row = scale_rows.get(job_id)
+    if not row:
+        return "-"
+    actual = row.get("actual_shards", "?")
+    desired = row.get("desired_shards", actual)
+    cell = f"{actual}" if actual == desired else f"{actual}->{desired}"
+    reason = row.get("last_reason")
+    if reason:
+        cell += f" {reason}"
+    return cell
+
+
 def render_frame(
     status: dict,
     metrics_snap: dict,
@@ -80,9 +96,10 @@ def render_frame(
     )
     jobs = status.get("status", {}).get("jobs", {})
     hist_jobs = metrics_snap.get("histograms", {}).get("jobs", {})
+    scale_rows = metrics_snap.get("scale", {})
     lines.append(
         f"{'JOB':<24} {'STATE':<9} {'RECORDS':>8} {'EPS':>8} {'QUEUE':>5} "
-        f"{'CLOSE p50/p99ms':>16} {'1ST-EMIT p50ms':>14}"
+        f"{'CLOSE p50/p99ms':>16} {'1ST-EMIT p50ms':>14} {'SCALE':<14}"
     )
     for job_id in sorted(jobs):
         row = jobs[job_id]
@@ -99,7 +116,8 @@ def render_frame(
             f"{row.get('job_records', 0):>8} {_fmt_eps(eps):>8} "
             f"{row.get('queue_depth', 0):>5} "
             f"{_quantiles(hrows, 'window_close_to_emission_ms'):>16} "
-            f"{first_s:>14}"
+            f"{first_s:>14} "
+            f"{_scale_cell(scale_rows, job_id):<14.14}"
         )
     if health:
         hjobs = health.get("jobs", {})
@@ -182,6 +200,9 @@ def frame_dict(
         "histograms": metrics_snap.get("histograms", {}),
         "health": health.get("jobs", {}),
         "alerts": health.get("alerts", []),
+        # the elastic control plane's desired-vs-actual geometry rows
+        # (utils.metrics job scale gauges, via the metrics verb)
+        "scale": metrics_snap.get("scale", {}),
     }
 
 

@@ -277,17 +277,28 @@ def replay(path: str) -> List[dict]:
     return out
 
 
-def job_lifecycle(events: List[dict], job: str) -> List[str]:
-    """Reconstruct one job's state sequence from replayed events:
-    ``["PENDING", "RUNNING", ..., terminal]``.  Raises on a broken chain
-    (a transition whose ``from`` is not the current state) — the journal
-    is supposed to be a complete record, and a gap must be loud."""
+def job_history(events: List[dict], job: str) -> List[List[str]]:
+    """Every incarnation of a job's state sequence, oldest first.
+
+    A job name is reused across a live re-shard (the elastic control
+    plane drains and RESUBMITS under the same id, runtime/autoscale.py),
+    so one name can carry several complete lifecycles in one journal.
+    Each ``job_submitted`` opens a new incarnation; transitions chain
+    inside it under the same broken-chain check as :func:`job_lifecycle`
+    (which keeps returning the LATEST incarnation).  A rescaled job's
+    full chain is therefore
+    ``[[PENDING, RUNNING, ..., CANCELLED], [PENDING, RUNNING, ..., DONE]]``
+    with the ``scale_decision``/``scale_done`` records sitting between
+    the two by seq order.
+    """
+    history: List[List[str]] = []
     states: List[str] = []
     for ev in events:
         if ev.get("job") != job:
             continue
         if ev.get("kind") == "job_submitted":
             states = ["PENDING"]
+            history.append(states)
         elif ev.get("kind") == "job_transition":
             if states and ev.get("from") != states[-1]:
                 raise ValueError(
@@ -297,5 +308,17 @@ def job_lifecycle(events: List[dict], job: str) -> List[str]:
                 )
             if not states:
                 states = [ev.get("from")]
+                history.append(states)
             states.append(ev.get("to"))
-    return states
+    return history
+
+
+def job_lifecycle(events: List[dict], job: str) -> List[str]:
+    """Reconstruct one job's state sequence from replayed events:
+    ``["PENDING", "RUNNING", ..., terminal]``.  Raises on a broken chain
+    (a transition whose ``from`` is not the current state) — the journal
+    is supposed to be a complete record, and a gap must be loud.  For a
+    name resubmitted across a rescale this is the LATEST incarnation;
+    :func:`job_history` returns them all."""
+    history = job_history(events, job)
+    return history[-1] if history else []

@@ -452,10 +452,11 @@ def drop_job_stats(job_id: str) -> None:
         for key in [k for k in _HISTS if k[0] == "job" and k[1] == job_id]:
             del _HISTS[key]
     # the health plane's rows leave with the job too: gauges (a stale
-    # backlog row would keep an SLO alert burning on a dead job) and the
-    # job's alert rows themselves
+    # backlog row would keep an SLO alert burning on a dead job), the
+    # job's alert rows, and its elastic-control-plane scale row
     drop_job_health(job_id)
     drop_alerts("job", job_id)
+    drop_job_scale(job_id)
 
 
 def reset_job_stats() -> None:
@@ -673,6 +674,57 @@ def drop_job_health(job_id: str) -> None:
 def reset_job_health() -> None:
     with _HEALTH_LOCK:
         _JOB_HEALTH.clear()
+
+
+# ---------------------------------------------------------------------------
+# Per-job scale gauges (the elastic control plane, ISSUE 11).  One row per
+# autoscale-managed job: the geometry the policy WANTS (desired_shards) next
+# to the geometry the job RUNS AT (actual_shards), the last decision's
+# reason, and the rescale count/downtime — what gelly-top's SCALE column and
+# the Prometheus exposition read.  Written by the autoscaler's policy thread
+# and its register/unregister callers (server connection threads), read by
+# status()/metrics consumers, so the registry is lock-guarded like its
+# siblings.  A desired != actual row IS the alert: the policy decided and
+# the actuation hasn't landed (or failed and is cooling down).
+
+
+_SCALE_LOCK = threading.Lock()
+# job id -> gauge dict; rows appear at autoscaler registration, leave when
+# the job is unregistered (terminal) or evicted
+_JOB_SCALE: dict = {}  # guarded-by: _SCALE_LOCK
+
+
+def job_scale_update(job_id: str, gauges: dict) -> None:
+    """Merge scale gauges into a job's row (policy sweep + actuation both
+    write partial updates; merge keeps the rescale history fields)."""
+    with _SCALE_LOCK:
+        row = _JOB_SCALE.get(job_id)
+        if row is None:
+            row = _JOB_SCALE[job_id] = {}
+        row.update(gauges)
+
+
+def job_scale(job_id: str) -> dict:
+    """One job's scale row ({} until the autoscaler manages it)."""
+    with _SCALE_LOCK:
+        return dict(_JOB_SCALE.get(job_id) or {})
+
+
+def all_job_scale() -> dict:
+    """{job id -> scale gauge dict} snapshot of every managed job."""
+    with _SCALE_LOCK:
+        return {jid: dict(row) for jid, row in _JOB_SCALE.items()}
+
+
+def drop_job_scale(job_id: str) -> None:
+    """Forget a job's scale row (autoscaler unregister / job eviction)."""
+    with _SCALE_LOCK:
+        _JOB_SCALE.pop(job_id, None)
+
+
+def reset_job_scale() -> None:
+    with _SCALE_LOCK:
+        _JOB_SCALE.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -901,6 +953,7 @@ def metrics_snapshot() -> dict:
         "histograms": hist_snapshot(),
         "spans": tracing.span_stats(),
         "health": all_job_health(),
+        "scale": all_job_scale(),
         "alerts": all_alerts(),
         "events": events.journal().stats(),
     }
@@ -958,6 +1011,7 @@ def render_prometheus(snap: Optional[dict] = None) -> str:
         ("jobs", "job"),
         ("tenants", "tenant"),
         ("health", "job"),
+        ("scale", "job"),
     ):
         rows = snap.get(scope_key, {})
         keys = sorted(

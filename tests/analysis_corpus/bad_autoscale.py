@@ -1,0 +1,44 @@
+"""Seeded bugs for the elastic-control-plane fixtures (ISSUE 11): the
+autoscaler's '# guarded-by:' handle/streak registry written without the
+lock (a connection thread registering while the policy thread sweeps
+loses the registration — or the sweep iterates a dict being resized under
+it), and a device sync smuggled into the decision sweep (materializing a
+"gauge" from a device array blocks the policy tick on the data plane it
+is supposed to merely observe — and, transitively, delays every pending
+rescale behind one fold).
+
+Expected findings: one HOTSYNC, five UNGUARDED (register's two
+lost-update writes, the sweep's unguarded dict iteration, and the streak
+read-modify-write pair).  Analyzer input only — never imported.
+"""
+
+import threading
+
+import numpy as np
+
+
+class Autoscaler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles = {}  # guarded-by: _lock
+        self._streaks = {}  # guarded-by: _lock
+
+    def register(self, job_id, handle):
+        self._handles[job_id] = handle  # BUG: races the sweeping policy thread
+        self._streaks[job_id] = 0  # BUG: lost registration under contention
+
+    def sweep(self, gauges, page_hold, actuate):
+        decisions = []
+        # hot-loop: autoscale decision sweep (alert reads + streak math)
+        for job_id, handle in self._handles.items():
+            # BUG: a device-array gauge materialized inline stalls the
+            # policy tick on the device pipeline it is observing
+            lag = float(np.asarray(gauges[job_id]))
+            streak = self._streaks.get(job_id, 0) + 1 if lag > 0 else 0
+            self._streaks[job_id] = streak  # BUG: unguarded streak write
+            if streak >= page_hold:
+                decisions.append((job_id, handle))
+        # hot-loop-end
+        for job_id, handle in decisions:
+            actuate(job_id, handle)
+        return decisions

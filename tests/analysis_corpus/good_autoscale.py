@@ -1,0 +1,55 @@
+"""Clean counterpart for the elastic-control-plane fixtures (ISSUE 11):
+the autoscaler's handle/decision registry lives under ONE lock (server
+connection threads register jobs while the policy thread sweeps), and the
+decision sweep itself is a '# hot-loop' region of alert/gauge registry
+reads and streak arithmetic — a gauge is a host-side Python number by
+contract, never a device value the sweep would have to sync on.
+Actuation (the drain + resubmit, which legitimately blocks for seconds)
+runs OUTSIDE both the lock and the marked region.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class Autoscaler:
+    """Handle registry + per-job streaks: registered from connection
+    threads, swept by the policy thread, so every access holds the one
+    autoscaler lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles = {}  # guarded-by: _lock
+        self._streaks = {}  # guarded-by: _lock
+
+    def register(self, job_id, handle):
+        with self._lock:
+            self._handles[job_id] = handle
+            self._streaks[job_id] = 0
+
+    def unregister(self, job_id):
+        with self._lock:
+            self._handles.pop(job_id, None)
+            self._streaks.pop(job_id, None)
+
+    def sweep(self, alerts, page_hold, actuate):
+        """One policy evaluation: decide under the lock from host-side
+        registry reads, actuate outside it (a drain takes seconds and
+        registration must never wait on it)."""
+        decisions = []
+        with self._lock:
+            # hot-loop: autoscale decision sweep (alert reads + streak math)
+            for job_id, handle in self._handles.items():
+                paging = any(
+                    a.get("state") == "PAGE"
+                    for a in alerts.get(job_id, [])
+                )
+                streak = self._streaks[job_id] + 1 if paging else 0
+                self._streaks[job_id] = streak
+                if streak >= page_hold:
+                    decisions.append((job_id, handle))
+            # hot-loop-end
+        for job_id, handle in decisions:
+            actuate(job_id, handle)
+        return decisions

@@ -255,6 +255,27 @@ def test_corpus_events():
     assert _analyze("good_events.py") == []
 
 
+def test_corpus_autoscale():
+    """The elastic-control-plane fixtures (ISSUE 11): the autoscaler's
+    handle/streak decision registry is '# guarded-by:' its lock
+    (connection threads register while the policy thread sweeps), and the
+    decision sweep is a '# hot-loop' region — alert/gauge reads and
+    streak math only, never a device sync that would stall a pending
+    rescale behind one fold."""
+    findings = _analyze("bad_autoscale.py")
+    assert _codes(findings) == [
+        "HOTSYNC",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+    ]
+    assert any("self._handles" in f.message for f in findings)
+    assert any("self._streaks" in f.message for f in findings)
+    assert _analyze("good_autoscale.py") == []
+
+
 def test_corpus_collgather():
     findings = _analyze("bad_collgather.py")
     assert _codes(findings) == ["COLLGATHER", "COLLGATHER", "COLLGATHER"]

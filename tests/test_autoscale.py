@@ -1,0 +1,983 @@
+"""Elastic control plane (ISSUE 11): health-driven live re-sharding.
+
+The contracts under test:
+
+* POLICY — deterministic, injected-clock walks of the decision rules:
+  sustained PAGE scales up by the factor, sustained over-provisioned-idle
+  scales down, cooldown spaces decisions, a failing actuator journals
+  ``scale_failed`` and cools down instead of retrying at tick rate,
+  terminal jobs retire their registration and scale gauges.
+* ACTUATION — a served push job drained and resubmitted at 2x the shard
+  geometry resumes bit-exactly from its checkpoint cursor: emissions
+  across the rescale are overlap-only, the non-idempotent degree counts
+  are exact (every edge folded exactly once into persistent state), and
+  mid-swap pushes are refused ``quiesced``/typed so the client re-pushes
+  from the cursor.
+* FAULT INJECTION — the acceptance walk: a deliberately lagging job
+  (1-record results buffer nobody drains) pages its backlog-age SLO, the
+  autoscaler drains + resubmits it at 2x, the alert walks back down
+  through the normal hysteretic path once a consumer appears, and the
+  ENTIRE decision chain (both job incarnations + scale events) replays
+  from the JSONL journal.
+* OFF BY DEFAULT — with ``RuntimeConfig.autoscale`` unset and no
+  ``GELLY_AUTOSCALE``, no policy thread exists and emissions/recompiles
+  are bit-identical to a run with the control plane enabled but
+  untriggered.
+
+Every threaded test carries ``timeout_cap``.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import (
+    AutoscalePolicy,
+    RuntimeConfig,
+    ServerConfig,
+    SLOSpec,
+    StreamConfig,
+)
+from gelly_streaming_tpu.runtime import JobManager
+from gelly_streaming_tpu.runtime.autoscale import (
+    Autoscaler,
+    resolve_autoscale,
+)
+from gelly_streaming_tpu.runtime.client import (
+    GellyClient,
+    ServerRefused,
+)
+from gelly_streaming_tpu.runtime.server import (
+    StreamServer,
+    _ServedRescaleTarget,
+)
+from gelly_streaming_tpu.utils import events, metrics
+
+pytestmark = pytest.mark.timeout_cap(300)
+
+CAP = 1 << 12
+W = 1 << 10
+B = 1 << 9
+
+
+def _graph(seed: int, n: int, cap: int = CAP):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, cap, n).astype(np.int32),
+        rng.integers(0, cap, n).astype(np.int32),
+    )
+
+
+def _reset_registries():
+    metrics.reset_alerts()
+    metrics.reset_job_health()
+    metrics.reset_job_scale()
+    metrics.reset_histograms()
+    events.configure(path=None)
+
+
+class FakeHandle:
+    """A scripted RescaleTarget for the deterministic policy walks."""
+
+    def __init__(self, shards: int = 1, state: str = "RUNNING", fail=False):
+        self.shards = shards
+        self.state = state
+        self.fail = fail
+        self.calls = []
+
+    def job_state(self):
+        return self.state
+
+    def current_shards(self):
+        return self.shards
+
+    def eligible(self, num_shards):
+        return 1 <= num_shards <= 8
+
+    def rescale(self, num_shards, reason):
+        self.calls.append((num_shards, reason))
+        if self.fail:
+            raise RuntimeError("injected actuation failure")
+        self.shards = num_shards
+        return {"resume_edges": num_shards * 1024}
+
+
+# ---------------------------------------------------------------------------
+# config + switch resolution
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_policy_validation():
+    with pytest.raises(ValueError, match="factor"):
+        AutoscalePolicy(factor=1)
+    with pytest.raises(ValueError, match="page_hold"):
+        AutoscalePolicy(page_hold=0)
+    with pytest.raises(ValueError, match="idle_keepup"):
+        AutoscalePolicy(idle_keepup=1.0)
+    with pytest.raises(ValueError, match="max_shards"):
+        AutoscalePolicy(min_shards=4, max_shards=2)
+    with pytest.raises(ValueError, match="interval_s"):
+        AutoscalePolicy(interval_s=0)
+    with pytest.raises(ValueError, match="autoscale must be"):
+        RuntimeConfig(autoscale=7)
+    with pytest.raises(ValueError, match="AutoscalePolicy"):
+        RuntimeConfig(autoscale_policy={"factor": 2})
+
+
+def test_resolve_autoscale_config_and_env(monkeypatch):
+    monkeypatch.delenv("GELLY_AUTOSCALE", raising=False)
+    assert not resolve_autoscale(RuntimeConfig())  # default OFF
+    assert resolve_autoscale(RuntimeConfig(autoscale=1))
+    assert not resolve_autoscale(RuntimeConfig(autoscale=0))
+    monkeypatch.setenv("GELLY_AUTOSCALE", "1")
+    assert resolve_autoscale(RuntimeConfig())
+    assert not resolve_autoscale(RuntimeConfig(autoscale=0))  # config wins
+    monkeypatch.setenv("GELLY_AUTOSCALE", "maybe")
+    with pytest.raises(ValueError, match="GELLY_AUTOSCALE"):
+        resolve_autoscale(RuntimeConfig())
+
+
+def test_manager_starts_no_autoscaler_by_default(monkeypatch):
+    monkeypatch.delenv("GELLY_AUTOSCALE", raising=False)
+    with JobManager() as jm:
+        job = jm.submit(lambda: iter(()), name="plain")
+        job.collect()
+        assert jm.autoscaler is None
+    with JobManager(RuntimeConfig(autoscale=1)) as jm:
+        job = jm.submit(lambda: iter(()), name="managed")
+        job.collect()
+        assert jm.autoscaler is not None
+        assert jm.autoscaler.stats()["running"]
+
+
+# ---------------------------------------------------------------------------
+# deterministic policy walks (injected clocks, scripted handles)
+# ---------------------------------------------------------------------------
+
+
+def _policy(**kw):
+    base = dict(page_hold=2, idle_hold=3, idle_keepup=4.0, cooldown_s=10.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def test_sustained_page_scales_up_and_cooldown_spaces_decisions():
+    _reset_registries()
+    journal = events.EventJournal(clock=lambda: 0.0)
+    h = FakeHandle()
+    a = Autoscaler(_policy(), clock=lambda: 0.0, journal=journal)
+    a.register("t/j", h)
+    metrics.alert_set("job", "t/j", "lag", {"state": "PAGE", "burn_fast": 9.0})
+    assert a.evaluate_once(0.0) == []  # streak 1 < page_hold
+    out = a.evaluate_once(1.0)  # streak 2 -> decide + actuate
+    assert len(out) == 1 and out[0]["ok"]
+    assert out[0]["direction"] == "up" and out[0]["new_shards"] == 2
+    assert out[0]["trigger"] == 9.0
+    assert h.calls == [(2, "page-burn")]
+    row = metrics.job_scale("t/j")
+    assert row["actual_shards"] == row["desired_shards"] == 2
+    assert row["rescales"] == 1 and row["last_reason"] == "page-burn"
+    # cooldown: still paging, but no decision until the quiet period ends
+    assert a.evaluate_once(2.0) == [] and a.evaluate_once(3.0) == []
+    assert h.shards == 2
+    # past cooldown the still-burning job doubles again (its streak kept
+    # accumulating through the quiet period)
+    out = a.evaluate_once(12.0)
+    assert out and out[0]["new_shards"] == 4 and h.shards == 4
+    kinds = [e["kind"] for e in journal.tail(100)]
+    assert kinds.count("scale_decision") == kinds.count("scale_done") == 2
+
+
+def test_sustained_idle_scales_down():
+    _reset_registries()
+    h = FakeHandle(shards=4)
+    a = Autoscaler(_policy(cooldown_s=0.0), clock=lambda: 0.0)
+    a.register("t/j", h)
+    metrics.job_health_set(
+        "t/j",
+        {"keepup_ratio": 9.0, "backlog_batches": 0, "watermark_lag_windows": 0},
+    )
+    outs = [a.evaluate_once(float(t)) for t in range(3)]
+    assert outs[0] == [] and outs[1] == []
+    assert outs[2] and outs[2][0]["direction"] == "down"
+    assert outs[2][0]["reason"] == "idle" and h.shards == 2
+    # a burning alert vetoes the idle verdict even with a huge keep-up
+    metrics.alert_set("job", "t/j", "lag", {"state": "WARN"})
+    for t in range(3, 9):
+        assert a.evaluate_once(float(t)) == []
+    assert h.shards == 2
+
+
+def test_idle_needs_empty_backlog_and_min_shards_floor():
+    _reset_registries()
+    h = FakeHandle(shards=2)
+    a = Autoscaler(_policy(idle_hold=1, cooldown_s=0.0), clock=lambda: 0.0)
+    a.register("t/j", h)
+    # backlog present: over-provisioned by rate but still holding bytes
+    metrics.job_health_set(
+        "t/j",
+        {"keepup_ratio": 9.0, "backlog_batches": 3, "watermark_lag_windows": 0},
+    )
+    assert a.evaluate_once(0.0) == []
+    metrics.job_health_set(
+        "t/j",
+        {"keepup_ratio": 9.0, "backlog_batches": 0, "watermark_lag_windows": 0},
+    )
+    assert a.evaluate_once(1.0)[0]["new_shards"] == 1
+    # at the floor: idle forever, no decision
+    for t in range(2, 6):
+        assert a.evaluate_once(float(t)) == []
+    assert h.shards == 1
+
+
+def test_failed_actuation_journals_scale_failed_and_cools_down():
+    _reset_registries()
+    journal = events.EventJournal(clock=lambda: 0.0)
+    h = FakeHandle(fail=True)
+    a = Autoscaler(_policy(page_hold=1), clock=lambda: 0.0, journal=journal)
+    a.register("t/j", h)
+    metrics.alert_set("job", "t/j", "lag", {"state": "PAGE"})
+    out = a.evaluate_once(0.0)
+    assert out and not out[0]["ok"] and "injected" in out[0]["error"]
+    assert a.stats()["failures"] == 1
+    failed = journal.tail(10, kind="scale_failed")
+    assert failed and failed[0]["old_shards"] == 1
+    row = metrics.job_scale("t/j")
+    # desired snaps back: the gauge must not advertise a geometry nobody
+    # is moving toward
+    assert row["desired_shards"] == row["actual_shards"] == 1
+    assert row["last_reason"] == "failed:page-burn"
+    # cooldown: the failing actuator is NOT retried at tick rate
+    assert a.evaluate_once(1.0) == [] and len(h.calls) == 1
+
+
+def test_terminal_job_retires_registration_and_scale_row():
+    _reset_registries()
+    h = FakeHandle()
+    a = Autoscaler(_policy(), clock=lambda: 0.0)
+    a.register("t/j", h)
+    assert metrics.job_scale("t/j")["actual_shards"] == 1
+    h.state = "DONE"
+    a.evaluate_once(0.0)
+    assert a.managed() == []
+    assert metrics.job_scale("t/j") == {}
+
+
+def test_broken_handle_degrades_not_kills_the_sweep():
+    _reset_registries()
+
+    class Broken(FakeHandle):
+        def __init__(self):
+            super().__init__()
+            self._armed = False  # registration's gauge seed still works
+
+        def current_shards(self):
+            if self._armed:
+                raise RuntimeError("probe died mid-life")
+            self._armed = True
+            return self.shards
+
+    good = FakeHandle()
+    a = Autoscaler(_policy(page_hold=1), clock=lambda: 0.0)
+    a.register("a/bad", Broken())
+    a.register("b/good", good)
+    metrics.alert_set("job", "b/good", "lag", {"state": "PAGE"})
+    out = a.evaluate_once(0.0)
+    assert [d["job"] for d in out] == ["b/good"] and good.shards == 2
+
+
+# ---------------------------------------------------------------------------
+# gelly-top SCALE surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_top_frame_carries_scale_rows():
+    from gelly_streaming_tpu.runtime.top import frame_dict, render_frame
+
+    status = {
+        "server": {"connections": 1, "served_jobs": 1, "port": 7},
+        "status": {"jobs": {"t/j": {"state": "RUNNING", "job_edges": 10}}},
+    }
+    snap = {
+        "tenants": {},
+        "pipeline": {},
+        "scale": {
+            "t/j": {
+                "actual_shards": 2,
+                "desired_shards": 4,
+                "last_reason": "page-burn",
+            }
+        },
+    }
+    frame = frame_dict(status, snap, None, None)
+    assert frame["scale"]["t/j"]["desired_shards"] == 4
+    import json
+
+    json.dumps(frame)
+    lines = render_frame(status, snap, None, None)
+    assert any("SCALE" in line for line in lines)
+    assert any("2->4 page-burn" in line for line in lines)
+    # an unmanaged job renders "-"
+    snap2 = dict(snap, scale={})
+    assert any(
+        line.rstrip().endswith("-") for line in render_frame(status, snap2, None, None)
+    )
+
+
+# ---------------------------------------------------------------------------
+# journal helpers: incarnation history
+# ---------------------------------------------------------------------------
+
+
+def test_job_history_reconstructs_both_incarnations():
+    j = events.EventJournal()
+    j.emit("job_submitted", job="t/x")
+    for frm, to in (("PENDING", "RUNNING"), ("RUNNING", "CANCELLED")):
+        j.emit("job_transition", job="t/x", **{"from": frm, "to": to})
+    j.emit("scale_decision", job="t/x", old_shards=1, new_shards=2)
+    j.emit("scale_done", job="t/x", old_shards=1, new_shards=2)
+    j.emit("job_submitted", job="t/x")
+    for frm, to in (
+        ("PENDING", "RUNNING"),
+        ("RUNNING", "DRAINING"),
+        ("DRAINING", "DONE"),
+    ):
+        j.emit("job_transition", job="t/x", **{"from": frm, "to": to})
+    evs = j.tail(100)
+    assert events.job_history(evs, "t/x") == [
+        ["PENDING", "RUNNING", "CANCELLED"],
+        ["PENDING", "RUNNING", "DRAINING", "DONE"],
+    ]
+    # job_lifecycle keeps returning the LATEST incarnation
+    assert events.job_lifecycle(evs, "t/x") == [
+        "PENDING",
+        "RUNNING",
+        "DRAINING",
+        "DONE",
+    ]
+    # the scale records sit between the incarnations in seq order
+    seqs = {e["kind"]: e["seq"] for e in evs}
+    cancel_seq = max(
+        e["seq"] for e in evs if e.get("to") == "CANCELLED"
+    )
+    resubmit_seq = max(
+        e["seq"] for e in evs if e["kind"] == "job_submitted"
+    )
+    assert cancel_seq < seqs["scale_decision"] < seqs["scale_done"] < resubmit_seq
+
+
+# ---------------------------------------------------------------------------
+# served-job actuation: drain -> 2x geometry -> bit-exact resume
+# ---------------------------------------------------------------------------
+
+
+def _window_id(deg_record: np.ndarray) -> int:
+    """Infer a degree record's window id: sum(deg) == 2 * edges folded ==
+    2 * (window + 1) * W (every edge adds one to each endpoint)."""
+    total = int(deg_record.sum())
+    assert total % (2 * W) == 0, total
+    return total // (2 * W) - 1
+
+
+def _assert_overlap_only(records, src, dst, n_windows, resume_w):
+    """Every record bit-matches its window's fresh-fold oracle prefix;
+    coverage is complete; duplicates only in the checkpoint-to-drain
+    overlap region starting at the resume cursor (at-least-once)."""
+    seen: dict = {}
+    for rec in records:
+        deg = np.asarray(rec[0])
+        k = _window_id(deg)
+        edges = (k + 1) * W
+        oracle = np.bincount(src[:edges], minlength=CAP) + np.bincount(
+            dst[:edges], minlength=CAP
+        )
+        assert np.array_equal(deg, oracle.astype(deg.dtype)), f"window {k}"
+        seen[k] = seen.get(k, 0) + 1
+    assert set(seen) == set(range(n_windows)), sorted(seen)
+    dups = sorted(k for k, c in seen.items() if c > 1)
+    assert all(c <= 2 for c in seen.values())
+    # overlap-only: re-emitted windows are exactly a contiguous run from
+    # the resume cursor (emitted pre-drain past the last landed snapshot)
+    assert dups == list(range(resume_w, resume_w + len(dups))), (
+        dups,
+        resume_w,
+    )
+
+
+def test_served_rescale_resumes_bit_exact_at_2x(tmp_path):
+    _reset_registries()
+    n_windows = 16
+    n = n_windows * W
+    s, d = _graph(41, n)
+    rt = RuntimeConfig(health_sample_s=0.0)
+    with JobManager(rt) as jm, StreamServer(
+        jm, ServerConfig(checkpoint_prefix=str(tmp_path / "ck"))
+    ) as server:
+        with GellyClient("127.0.0.1", server.port) as c:
+            reply = c.submit(
+                name="dj",
+                query="degree",
+                capacity=CAP,
+                window_edges=W,
+                batch=B,
+                checkpoint=True,
+            )
+            assert reply["resume_edges"] == 0
+            head = 8 * W
+            c.push_edges("dj", s[:head], d[:head], batch=B, capacity=CAP, close=False)
+            records = []
+            while len(records) < 4:  # let several windows fold + checkpoint
+                recs, state, _eos = c.results("dj", timeout_ms=2000)
+                records.extend(recs)
+                assert state not in ("FAILED", "CANCELLED")
+            with server._lock:
+                sj = server._jobs["default/dj"]
+            handle = _ServedRescaleTarget(server, sj)
+            assert handle.current_shards() == 1
+            assert handle.eligible(2) and not handle.eligible(3)
+            res = handle.rescale(2, "test")
+            resume = res["resume_edges"]
+            assert 0 < resume <= head and resume % W == 0
+            assert sj.cfg.num_shards == 2 and sj.job.state != "CANCELLED"
+            # a push against the OLD pre-swap position is impossible now;
+            # the client re-pushes everything from the cursor
+            c.push_edges(
+                "dj", s, d, batch=B, capacity=CAP, start=resume, close=True
+            )
+            for rec in c.iter_results("dj", deadline_s=240):
+                records.append(rec)
+            _assert_overlap_only(records, s, d, n_windows, resume // W)
+            # the swap re-priced, never double-booked: exactly one job's
+            # state bytes admitted, nothing stuck in the reservation
+            status = jm.status()
+            assert status["reserved_state_bytes"] == 0
+            assert (
+                status["admitted_state_bytes"] == 0
+            )  # job DONE: budget returned
+    _reset_registries()
+
+
+def test_mid_swap_push_is_refused_quiesced_then_client_recovers(tmp_path):
+    """Pushes racing the swap get the typed ``quiesced`` refusal (their
+    batches are the client's to re-push from the cursor) — the pipelined
+    push drain surfaces it as ServerRefused without desyncing the
+    connection, and the SAME connection then completes the stream."""
+    _reset_registries()
+    n_windows = 12
+    n = n_windows * W
+    s, d = _graph(43, n)
+    with JobManager() as jm, StreamServer(
+        jm, ServerConfig(checkpoint_prefix=str(tmp_path / "ck"))
+    ) as server:
+        with GellyClient("127.0.0.1", server.port) as c:
+            c.submit(
+                name="rj",
+                query="degree",
+                capacity=CAP,
+                window_edges=W,
+                batch=B,
+                checkpoint=True,
+            )
+            with server._lock:
+                sj = server._jobs["default/rj"]
+            handle = _ServedRescaleTarget(server, sj)
+            stop = threading.Event()
+            errors = []
+
+            def pusher():
+                start = 0
+                while not stop.is_set() and start < n:
+                    try:
+                        c.push_edges(
+                            "rj",
+                            s[: start + 2 * W],
+                            d[: start + 2 * W],
+                            batch=B,
+                            capacity=CAP,
+                            start=start,
+                            close=False,
+                        )
+                        start += 2 * W
+                    except ServerRefused as e:
+                        if e.code not in ("quiesced", "out-of-sync"):
+                            errors.append(e)
+                            return
+                        # the rescale contract: a quiesced refusal (the
+                        # swap in progress) or a positionally-stale frame
+                        # landing after it both mean the same thing —
+                        # stop, then re-push from the NEW cursor
+                        time.sleep(0.05)
+                        return
+
+            th = threading.Thread(target=pusher)
+            th.start()
+            time.sleep(0.1)  # let some pushes land
+            res = handle.rescale(2, "test")
+            stop.set()
+            th.join(60)
+            assert not errors, errors
+            resume = res["resume_edges"]
+            # the same connection finishes the stream from the cursor
+            c.push_edges(
+                "rj", s, d, batch=B, capacity=CAP, start=resume, close=True
+            )
+            records = list(c.iter_results("rj", deadline_s=240))
+            final = np.asarray(records[-1][0])
+            oracle = np.bincount(s, minlength=CAP) + np.bincount(
+                d, minlength=CAP
+            )
+            assert np.array_equal(final, oracle.astype(final.dtype))
+    _reset_registries()
+
+
+def test_tenant_caps_hold_across_the_rescale_swap_window(tmp_path):
+    """Mid-swap, the draining job reads terminal/zero-byte, so the
+    per-tenant cap arithmetic would see a vacancy — the tenant-swap
+    figures must keep both the byte and the job cap charged until the
+    resubmit lands (the manager-level reservation's guarantee, applied
+    one layer up)."""
+    _reset_registries()
+    from gelly_streaming_tpu.core.config import TenantConfig
+    from gelly_streaming_tpu.library.degree_distribution import (
+        DegreeDistributionSummary,
+    )
+
+    cfg = StreamConfig(
+        vertex_capacity=CAP, batch_size=B, ingest_window_edges=W
+    )
+    one_job = DegreeDistributionSummary().state_nbytes(cfg)
+    srv_cfg = ServerConfig(
+        tenants=(
+            TenantConfig(
+                tenant="t",
+                token="tok",
+                max_jobs=2,
+                max_state_bytes=one_job,
+            ),
+        ),
+        checkpoint_prefix=str(tmp_path / "ck"),
+    )
+    with JobManager() as jm, StreamServer(jm, srv_cfg) as server:
+        with GellyClient("127.0.0.1", server.port, token="tok") as c:
+            c.submit(
+                name="scaling",
+                query="degree",
+                capacity=CAP,
+                window_edges=W,
+                batch=B,
+                checkpoint=True,
+            )
+            with server._lock:
+                sj = server._jobs["t/scaling"]
+            # open the swap window exactly as _rescale_served does, then
+            # drain the old job to its mid-swap terminal/zero-byte state
+            with server._admission:
+                reserved = jm.begin_rescale(sj.job, one_job)
+                server._tenant_swap_begin("t", one_job)
+            sj.source.quiesce()
+            jm.cancel(sj.job, wait=True)
+            assert sj.job.state_bytes == 0  # the vacancy a thief would see
+            # the tenant's byte cap still reads FULL: a concurrent
+            # same-tenant submit is refused, not admitted into the gap
+            with pytest.raises(ServerRefused) as ei:
+                c.submit(
+                    name="thief",
+                    query="degree",
+                    capacity=CAP,
+                    window_edges=W,
+                    batch=B,
+                )
+            assert ei.value.code == "admission"
+            assert "state-byte cap" in str(ei.value)
+            # close the window; the budget frees and the tenant can
+            # submit again
+            jm.abort_rescale(reserved)
+            server._tenant_swap_end("t", one_job)
+            c.submit(
+                name="after",
+                query="degree",
+                capacity=CAP,
+                window_edges=W,
+                batch=B,
+            )
+    _reset_registries()
+
+
+def test_tenant_job_cap_counts_inflight_swaps(tmp_path):
+    _reset_registries()
+    from gelly_streaming_tpu.core.config import TenantConfig
+
+    srv_cfg = ServerConfig(
+        tenants=(TenantConfig(tenant="t", token="tok", max_jobs=1),),
+        checkpoint_prefix=str(tmp_path / "ck"),
+    )
+    with JobManager() as jm, StreamServer(jm, srv_cfg) as server:
+        with GellyClient("127.0.0.1", server.port, token="tok") as c:
+            c.submit(
+                name="scaling",
+                query="degree",
+                capacity=CAP,
+                window_edges=W,
+                batch=B,
+                checkpoint=True,
+            )
+            with server._lock:
+                sj = server._jobs["t/scaling"]
+            server._tenant_swap_begin("t", 0)
+            sj.source.quiesce()
+            jm.cancel(sj.job, wait=True)  # live jobs now 0, swaps 1
+            with pytest.raises(ServerRefused, match="job cap"):
+                c.submit(
+                    name="thief",
+                    query="degree",
+                    capacity=CAP,
+                    window_edges=W,
+                    batch=B,
+                )
+            server._tenant_swap_end("t", 0)
+            c.submit(
+                name="after",
+                query="degree",
+                capacity=CAP,
+                window_edges=W,
+                batch=B,
+            )
+    _reset_registries()
+
+
+def test_push_offset_guard_refuses_positionally_stale_frames(tmp_path):
+    """The positional wire guard: a push declaring an offset that is not
+    the source's accepted-edge count is refused ``out-of-sync`` (the
+    stale-pipelined-frame-after-a-swap hole), the connection survives,
+    and correctly-offset pushes proceed.  Undeclared offsets keep the
+    legacy no-check behavior."""
+    _reset_registries()
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.io.sources import (
+        NetworkEdgeSource,
+        PushOutOfSync,
+    )
+
+    cfg = StreamConfig(
+        vertex_capacity=CAP, batch_size=B, ingest_window_edges=W
+    )
+    s, d = _graph(59, B)
+    # unit: the source's own check, resume filler included
+    src = NetworkEdgeSource(cfg, B, resume_edges=2 * W, max_queued_batches=4)
+    with pytest.raises(PushOutOfSync, match="re-push from"):
+        src.push_tail(s, d, offset=0)  # the pre-rescale stream's position
+    assert src.push_tail(s, d, offset=2 * W) == B  # cursor-exact: accepted
+    assert src.push_tail(s, d) == B  # no declaration: legacy behavior
+    # end to end: the server maps it to the typed out-of-sync refusal and
+    # the SAME connection recovers with the right offset
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as server:
+        with GellyClient("127.0.0.1", server.port) as c:
+            c.submit(
+                name="oj", query="degree", capacity=CAP, window_edges=W, batch=B
+            )
+            with pytest.raises(ServerRefused) as ei:
+                c.push_tail("oj", s, d, offset=5 * B)
+            assert ei.value.code == "out-of-sync"
+            # incremental multi-call pushes: each call ships a fresh
+            # chunk; 'position' declares the chunk's global offset (and
+            # declare_position=False keeps the legacy unchecked behavior)
+            n = 4 * W
+            s2, d2 = _graph(61, n)
+            half = n // 2
+            c.push_edges(
+                "oj", s2[:half], d2[:half], batch=B, capacity=CAP,
+                close=False,
+            )
+            c.push_edges(
+                "oj", s2[half : half + W], d2[half : half + W], batch=B,
+                capacity=CAP, close=False, position=half,
+            )
+            c.push_edges(
+                "oj", s2[half + W :], d2[half + W :], batch=B, capacity=CAP,
+                declare_position=False,
+            )
+            records = list(c.iter_results("oj", deadline_s=240))
+            final = np.asarray(records[-1][0])
+            oracle = np.bincount(s2, minlength=CAP) + np.bincount(
+                d2, minlength=CAP
+            )
+            assert np.array_equal(final, oracle.astype(final.dtype))
+    _reset_registries()
+
+
+def test_resume_pushes_reopens_a_quiesced_source():
+    """The rescale failure path's client story: a drain that never
+    completed reopens the source, so pushes flow again instead of being
+    refused quiesced forever."""
+    from gelly_streaming_tpu.core.config import StreamConfig
+    from gelly_streaming_tpu.io.sources import (
+        NetworkEdgeSource,
+        SourceQuiesced,
+    )
+
+    cfg = StreamConfig(
+        vertex_capacity=CAP, batch_size=B, ingest_window_edges=W
+    )
+    s, d = _graph(67, B)
+    src = NetworkEdgeSource(cfg, B, max_queued_batches=4)
+    src.quiesce()
+    assert src.draining
+    with pytest.raises(SourceQuiesced):
+        src.push_tail(s, d)
+    src.resume_pushes()
+    assert not src.draining
+    assert src.push_tail(s, d) == B
+    # a CLOSED source stays closed: resume_pushes is for drains only
+    src.close()
+    src.resume_pushes()
+    with pytest.raises(SourceQuiesced, match="closed"):
+        src.push_tail(s, d)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance walk: injected lag -> PAGE -> autoscale 2x -> hysteretic
+# clear -> exact counts -> full chain replayable from the JSONL journal
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injection_paged_job_autoscales_and_clears(tmp_path):
+    metrics.reset_alerts()
+    metrics.reset_job_health()
+    metrics.reset_job_scale()
+    metrics.reset_histograms()
+    journal_path = str(tmp_path / "events.jsonl")
+    events.configure(path=journal_path)
+    try:
+        spec = SLOSpec(
+            metric="max_backlog_age_s",
+            threshold=0.15,
+            error_budget=0.5,
+            fast_window_s=0.4,
+            slow_window_s=1.0,
+            warn_burn=1.0,
+            page_burn=1.5,
+            clear_hold=2,
+        )
+        policy = AutoscalePolicy(
+            page_hold=2,
+            idle_hold=10_000,  # this walk exercises scale-UP only
+            cooldown_s=300.0,  # one decision per test
+            interval_s=0.05,
+        )
+        rt = RuntimeConfig(
+            health_sample_s=0.03,
+            slos=(spec,),
+            slo_interval_s=0.25,
+            job_queue_depth=2,
+            autoscale=1,
+            autoscale_policy=policy,
+        )
+        n_windows = 24
+        n = n_windows * W
+        s, d = _graph(47, n)
+        with JobManager(rt) as jm, StreamServer(
+            jm,
+            ServerConfig(
+                result_buffer_records=1,
+                checkpoint_prefix=str(tmp_path / "ck"),
+            ),
+        ) as server:
+            with GellyClient("127.0.0.1", server.port) as c:
+                c.submit(
+                    name="hj",
+                    query="degree",
+                    capacity=CAP,
+                    window_edges=W,
+                    batch=B,
+                    checkpoint=True,
+                )
+                assert jm.autoscaler is not None
+                assert "default/hj" in jm.autoscaler.managed()
+                # inject lag: push the whole stream with nobody fetching
+                # results (1-record buffer + depth-2 queue = the scheduler
+                # wedges after ~3 windows; the backlog AGES).  The rescale
+                # may quiesce mid-push — that typed refusal is part of the
+                # contract under test.
+                try:
+                    c.push_edges(
+                        "hj", s, d, batch=B, capacity=CAP, close=False
+                    )
+                except ServerRefused as e:
+                    # quiesced = the swap caught the push mid-flight;
+                    # out-of-sync = a pipelined frame landed after it
+                    assert e.code in ("quiesced", "out-of-sync")
+
+                def wait_for(pred, what, deadline_s=120):
+                    deadline = time.monotonic() + deadline_s
+                    while time.monotonic() < deadline:
+                        if pred():
+                            return
+                        time.sleep(0.02)
+                    raise AssertionError(f"never observed: {what}")
+
+                # the autoscaler rescales the paged job to 2 shards
+                wait_for(
+                    lambda: metrics.job_scale("default/hj").get(
+                        "actual_shards"
+                    )
+                    == 2,
+                    "scale row at 2 shards",
+                )
+                done = events.journal().tail(50, kind="scale_done")
+                assert done and done[-1]["job"] == "default/hj"
+                assert done[-1]["old_shards"] == 1
+                assert done[-1]["new_shards"] == 2
+                assert done[-1]["reason"] == "page-burn"
+                assert done[-1]["downtime_ms"] >= 0
+                resume = int(done[-1]["resume_edges"])
+                assert resume % W == 0
+                # the PAGE that drove it is on the record
+                decisions = events.journal().tail(50, kind="scale_decision")
+                assert decisions[-1]["direction"] == "up"
+                # gelly-client events (the client API the CLI prints)
+                # shows the scale records, tenant-scoped
+                assert any(
+                    e["kind"] == "scale_done" for e in c.events(200)
+                )
+                scale_row = c.metrics()["scale"]["default/hj"]
+                assert scale_row["actual_shards"] == 2
+                assert scale_row["last_reason"] == "page-burn"
+
+                # recovery: re-push from the cursor (retrying while the
+                # swap settles) and consume everything
+                deadline = time.monotonic() + 120
+                while True:
+                    try:
+                        c.push_edges(
+                            "hj",
+                            s,
+                            d,
+                            batch=B,
+                            capacity=CAP,
+                            start=resume,
+                            close=True,
+                        )
+                        break
+                    except ServerRefused as e:
+                        assert e.code in ("quiesced", "out-of-sync")
+                        assert time.monotonic() < deadline
+                        time.sleep(0.05)
+                records = []
+                for rec in c.iter_results("hj", deadline_s=240):
+                    records.append(rec)
+                # exact non-idempotent counts: at-least-once emissions,
+                # exactly-once state, overlap only past the cursor
+                _assert_overlap_only(records, s, d, n_windows, resume // W)
+                # the SLO alert clears through the normal path: any
+                # recorded transition is a single hysteretic step, and
+                # the alert ends at OK
+                wait_for(
+                    lambda: (
+                        metrics.alert_state(
+                            "job", "default/hj", "max_backlog_age_s"
+                        )
+                        or {"state": "OK"}
+                    )["state"]
+                    == "OK",
+                    "alert cleared to OK",
+                )
+                alert_seq = [
+                    (e["from"], e["to"])
+                    for e in events.journal().tail(400, kind="alert")
+                    if e.get("id") == "default/hj"
+                ]
+                # the walk started at OK and reached PAGE (escalation may
+                # jump straight there when both windows exceed the page
+                # burn on one eval — that immediacy is by design); every
+                # DE-escalation is a single hysteretic step down
+                assert alert_seq and alert_seq[0][0] == "OK"
+                assert any(to == "PAGE" for _f, to in alert_seq)
+                for frm, to in alert_seq:
+                    if metrics.ALERT_LEVELS[to] < metrics.ALERT_LEVELS[frm]:
+                        assert (
+                            metrics.ALERT_LEVELS[frm]
+                            - metrics.ALERT_LEVELS[to]
+                            == 1
+                        )
+            assert jm.wait_all(120)
+        # the FULL decision chain replays from the JSONL file: first
+        # incarnation drains to CANCELLED, the scale records bridge, the
+        # second incarnation runs to DONE
+        replayed = events.replay(journal_path)
+        history = events.job_history(replayed, "default/hj")
+        assert len(history) == 2
+        assert history[0][:2] == ["PENDING", "RUNNING"]
+        assert history[0][-1] == "CANCELLED"
+        assert history[1][0] == "PENDING" and history[1][-1] == "DONE"
+        kinds = [e["kind"] for e in replayed]
+        assert "scale_decision" in kinds and "scale_done" in kinds
+        dec_seq = next(
+            e["seq"] for e in replayed if e["kind"] == "scale_decision"
+        )
+        cancel_seq = next(
+            e["seq"]
+            for e in replayed
+            if e["kind"] == "job_transition" and e.get("to") == "CANCELLED"
+        )
+        resubmit_seq = max(
+            e["seq"] for e in replayed if e["kind"] == "job_submitted"
+        )
+        assert cancel_seq < resubmit_seq
+        assert dec_seq < resubmit_seq
+        # torn-tail behavior unchanged: a crash mid-write past the scale
+        # records still replays everything before it
+        with open(journal_path, "a") as f:
+            f.write('{"seq": 999999, "kind": "scale_de')
+        assert len(events.replay(journal_path)) == len(replayed)
+    finally:
+        events.configure(path=None)
+        _reset_registries()
+
+
+# ---------------------------------------------------------------------------
+# off-by-default invariant: bit-identical emissions, zero recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_off_is_bit_identical_with_zero_recompiles(monkeypatch):
+    monkeypatch.delenv("GELLY_AUTOSCALE", raising=False)
+    _reset_registries()
+    cfg = StreamConfig(
+        vertex_capacity=CAP, batch_size=B, ingest_window_edges=W
+    )
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.degree_distribution import (
+        DegreeDistributionSummary,
+    )
+
+    s, d = _graph(53, 8 * W)
+
+    def run(rt_cfg):
+        with JobManager(rt_cfg) as jm:
+            job = jm.submit_aggregation(
+                EdgeStream.from_arrays(s, d, cfg),
+                DegreeDistributionSummary(),
+                name="inv",
+            )
+            return [np.asarray(rec[0]) for rec in job.results()]
+
+    off = run(RuntimeConfig())  # the default: no policy thread at all
+    metrics.reset_compile_cache_stats()
+    # enabled but never triggered (no SLOs -> nothing ever pages; no
+    # registered handles -> nothing to actuate): the control plane must
+    # be pure observation
+    on = run(
+        RuntimeConfig(
+            autoscale=1,
+            autoscale_policy=AutoscalePolicy(interval_s=0.01),
+            health_sample_s=0.01,
+        )
+    )
+    assert metrics.compile_cache_stats()["recompiles"] == 0
+    assert len(off) == len(on)
+    for a, b in zip(off, on):
+        assert np.array_equal(a, b)
+    _reset_registries()

@@ -69,6 +69,13 @@ def test_direction_rules():
     assert bench._bench_direction("wire_bytes_per_edge") == "lower"
     assert bench._bench_direction("cache_recompiles") == "lower"
     assert bench._bench_direction("pipeline_pack_stall_s") == "lower"
+    # the rescale sub-bench's keys (ISSUE 11): downtime regresses upward,
+    # the throughput figures downward
+    assert bench._bench_direction("rescale_downtime_ms") == "lower"
+    assert bench._bench_direction("rescale_post_eps_ratio") == "higher"
+    assert bench._bench_direction("rescale_pre_eps") == "higher"
+    assert bench._bench_direction("rescale_post_eps") == "higher"
+    assert bench._bench_direction("rescale_resume_edges") is None
     assert bench._bench_direction("edges") is None
     assert bench._bench_direction("link_regime") is None
 

@@ -439,6 +439,168 @@ def test_admission_byte_cap_rejects_and_releases():
         again.collect()
 
 
+def test_rescale_budget_swap_is_atomic_under_the_admission_lock():
+    """The elastic control plane's re-pricing (ISSUE 11): a draining
+    job's state bytes move into a swap reservation UNDER the admission
+    lock, so across the whole drain -> resubmit window (a) a same-size
+    swap never transiently double-books against the cap, and (b) a
+    concurrent tenant can never steal the freed budget mid-swap."""
+    s, d = _graph(29, n=WIN)
+    one_job = ConnectedComponents().state_nbytes(CFG_WIRE)
+    with JobManager(RuntimeConfig(max_state_bytes=one_job)) as jm:
+        gate = threading.Event()
+
+        def held_source():
+            gate.wait(60)
+            return iter(())
+
+        job = jm.submit(held_source, name="scaling", state_bytes=one_job)
+        # (a) cap == one job's bytes: a same-size re-pricing must fit —
+        # if old and new were ever both charged, this would reject
+        reserved = jm.begin_rescale(job, one_job)
+        assert reserved == one_job
+        assert job.state_bytes == 0  # budget moved, not freed
+        # (b) the reservation is committed budget: a concurrent tenant
+        # cannot grab it while the swap is in flight
+        with pytest.raises(AdmissionError, match="reserved"):
+            jm.submit_aggregation(
+                EdgeStream.from_arrays(s, d, CFG_WIRE),
+                ConnectedComponents(),
+                name="thief",
+            )
+        gate.set()
+        jm.cancel(job, wait=True)
+        status = jm.status()
+        # the drained job's release returned NOTHING to the pool (its
+        # budget lives in the reservation): admitted 0, reserved one_job
+        assert status["admitted_state_bytes"] == 0
+        assert status["reserved_state_bytes"] == one_job
+        # the resubmit consumes the reservation exactly
+        resubmitted = jm.submit(
+            lambda: iter(()),
+            name="scaling",
+            state_bytes=one_job,
+            reserved_bytes=reserved,
+        )
+        status = jm.status()
+        assert status["admitted_state_bytes"] == one_job
+        assert status["reserved_state_bytes"] == 0
+        # once the rescaled job finishes, the budget is free again
+        resubmitted.collect()
+        assert resubmitted.wait(30)
+        after = jm.submit_aggregation(
+            EdgeStream.from_arrays(s, d, CFG_WIRE),
+            ConnectedComponents(),
+            name="after",
+        )
+        after.collect()
+
+
+def test_rescale_budget_abort_returns_reservation():
+    """A swap that dies mid-flight must return its reservation to the
+    open pool — never leak budget out of circulation."""
+    one_job = ConnectedComponents().state_nbytes(CFG_WIRE)
+    s, d = _graph(31, n=WIN)
+    with JobManager(RuntimeConfig(max_state_bytes=one_job)) as jm:
+        job = jm.submit(lambda: iter(()), name="dies", state_bytes=one_job)
+        reserved = jm.begin_rescale(job, one_job)
+        jm.cancel(job, wait=True)
+        jm.abort_rescale(reserved)
+        assert jm.status()["reserved_state_bytes"] == 0
+        ok = jm.submit_aggregation(
+            EdgeStream.from_arrays(s, d, CFG_WIRE),
+            ConnectedComponents(),
+            name="pool-restored",
+        )
+        ok.collect()
+
+
+def test_rescale_reserves_the_job_slot_against_concurrent_submits():
+    """The swap holds its max_jobs SLOT too: mid-drain the old job reads
+    terminal, and without the slot reservation a concurrent submit could
+    fill the cap and strand the resubmit (refused 'job cap'), killing
+    the rescaled job."""
+    s, d = _graph(37, n=WIN)
+    with JobManager(RuntimeConfig(max_jobs=1)) as jm:
+        job = jm.submit(lambda: iter(()), name="scaling", state_bytes=0)
+        reserved = jm.begin_rescale(job, 0)
+        jm.cancel(job, wait=True)  # 0 active jobs — but 1 rescaling
+        with pytest.raises(AdmissionError, match="rescaling"):
+            jm.submit_aggregation(
+                EdgeStream.from_arrays(s, d, CFG_WIRE),
+                ConnectedComponents(),
+                name="slot-thief",
+            )
+        # the swap's own resubmit consumes exactly the reserved slot
+        resub = jm.submit(
+            lambda: iter(()),
+            name="scaling",
+            state_bytes=0,
+            reserved_bytes=reserved,
+        )
+        resub.collect()
+        jm.wait_all(30)
+        ok = jm.submit_aggregation(
+            EdgeStream.from_arrays(s, d, CFG_WIRE),
+            ConnectedComponents(),
+            name="after-slot",
+        )
+        ok.collect()
+
+
+def test_abort_rescale_restores_a_live_jobs_budget():
+    """The drain-failed path: a job whose cancel never completed is still
+    RUNNING — aborting the swap must re-charge its bytes (a live summary
+    with state_bytes=0 would let admission stack a second full job on
+    top) and release both reservations."""
+    one_job = ConnectedComponents().state_nbytes(CFG_WIRE)
+    with JobManager(RuntimeConfig(max_state_bytes=one_job)) as jm:
+        gate = threading.Event()
+
+        def held_source():
+            gate.wait(60)
+            return iter(())
+
+        job = jm.submit(held_source, name="undrainable", state_bytes=one_job)
+        reserved = jm.begin_rescale(job, one_job)
+        assert job.state_bytes == 0
+        # the drain "times out": the job is still live; abort restores
+        jm.abort_rescale(reserved, job=job, restore_state_bytes=one_job)
+        assert job.state_bytes == one_job
+        status = jm.status()
+        assert status["admitted_state_bytes"] == one_job
+        assert status["reserved_state_bytes"] == 0
+        # the cap is exactly honest again: a second job is refused...
+        with pytest.raises(AdmissionError, match="state-byte cap"):
+            jm.submit(lambda: iter(()), name="over", state_bytes=one_job)
+        gate.set()
+        jm.cancel(job, wait=True)
+        # ...and a TERMINAL job's abort restores nothing (budget is free)
+        jm.begin_rescale(job, one_job)  # held is already 0
+        jm.abort_rescale(reserved, job=job, restore_state_bytes=one_job)
+        assert jm.status()["admitted_state_bytes"] == 0
+
+
+def test_rescale_growth_beyond_cap_rejects_and_leaves_job_intact():
+    """Re-pricing at a BIGGER geometry admission-checks the growth; a
+    rejection leaves the job exactly as it was (still admitted)."""
+    one_job = ConnectedComponents().state_nbytes(CFG_WIRE)
+    with JobManager(RuntimeConfig(max_state_bytes=one_job)) as jm:
+        gate = threading.Event()
+
+        def held_source():
+            gate.wait(60)
+            return iter(())
+
+        job = jm.submit(held_source, name="fixed", state_bytes=one_job)
+        with pytest.raises(AdmissionError, match="re-pricing"):
+            jm.begin_rescale(job, 2 * one_job)
+        assert job.state_bytes == one_job  # untouched
+        assert jm.status()["reserved_state_bytes"] == 0
+        gate.set()
+        jm.cancel(job, wait=True)
+
+
 # ---------------------------------------------------------------------------
 # executable sharing across jobs (the co-scheduling thesis)
 # ---------------------------------------------------------------------------

@@ -448,6 +448,119 @@ def test_zero_recompiles_across_same_bucket_panes():
     assert str(out1[-1][0]) == str(out2[-1][0])
 
 
+# ---------------------------------------------------------------------------
+# reshard_summary (ISSUE 11): geometry re-route is bit-exact
+
+
+def _spec_for(agg_cls, cfg):
+    return agg_cls().sharded_state_spec(cfg)
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(leaf) for leaf in jax.tree.leaves(tree)]
+
+
+def _fold_summary(agg_cls, src, dst, cfg, val=None):
+    """The replay oracle: fold the stream fresh on the single-chip plane
+    and return the replicated summary pytree the spec's shard_summary
+    accepts (descriptor state, not the emitted transform view)."""
+    agg = agg_cls()
+    import jax.numpy as jnp
+
+    state = agg.initial_state(cfg)
+    n = len(src)
+    bs = cfg.batch_size
+    for i in range(0, max(n, 1), bs):
+        s = np.zeros((bs,), np.int32)
+        d = np.zeros((bs,), np.int32)
+        m = np.zeros((bs,), bool)
+        k = len(src[i : i + bs])
+        s[:k], d[:k], m[:k] = src[i : i + bs], dst[i : i + bs], True
+        v = None
+        if val is not None:
+            v = np.zeros((bs,), np.float32)
+            v[:k] = val[i : i + bs]
+            v = jnp.asarray(v)
+        if k == 0 and n:
+            continue
+        state = agg.update(
+            state, jnp.asarray(s), jnp.asarray(d), v, jnp.asarray(m)
+        )
+    return state
+
+
+@pytest.mark.parametrize(
+    "agg_cls", [ConnectedComponents, DegreeDistributionSummary]
+)
+@pytest.mark.parametrize(
+    "shape", ["skewed", "empty", "valued"], ids=["skewed", "empty", "valued"]
+)
+def test_reshard_round_trip_matches_fresh_shard_oracle(agg_cls, shape):
+    """S -> 2S -> S re-routing is bit-identical to sharding the replay
+    oracle's summary fresh at each geometry — the contract the elastic
+    control plane's state move rests on."""
+    from gelly_streaming_tpu.core.sharded_state import reshard_summary
+
+    cfg = _cfg(num_shards=4)
+    rng = np.random.default_rng(21)
+    if shape == "empty":
+        src = dst = np.zeros((0,), np.int32)
+        val = None
+    elif shape == "valued":
+        src, dst = _rand_edges(300, seed=22)
+        val = rng.random(300).astype(np.float32)
+    else:
+        # skew: one hub vertex on most destinations
+        src = rng.integers(0, CAP, 400).astype(np.int32)
+        dst = np.where(rng.random(400) < 0.7, 3, rng.integers(0, CAP, 400)).astype(np.int32)
+        val = None
+    summary = _fold_summary(agg_cls, src, dst, cfg, val=val)
+    spec = _spec_for(agg_cls, cfg)
+    blocks_4 = spec.shard_summary(summary, cfg, 4)
+    rerouted_8 = reshard_summary(blocks_4, cfg, 4, 8)
+    fresh_8 = spec.shard_summary(summary, cfg, 8)
+    for got, exp in zip(_leaves(rerouted_8), _leaves(fresh_8)):
+        assert got.shape == exp.shape and got.dtype == exp.dtype
+        assert np.array_equal(got, exp)
+    # ...and back: the round trip is the identity, bit for bit
+    back_4 = reshard_summary(rerouted_8, cfg, 8, 4)
+    for got, exp in zip(_leaves(back_4), _leaves(blocks_4)):
+        assert np.array_equal(got, exp)
+
+
+@pytest.mark.parametrize(
+    "agg_cls", [ConnectedComponents, DegreeDistributionSummary]
+)
+def test_reshard_initial_blocks_are_the_new_geometry_identity(agg_cls):
+    """Re-routing the fold identity lands exactly on the new geometry's
+    own initial blocks — restores and empty shards need no masking at
+    either scale."""
+    from gelly_streaming_tpu.core.sharded_state import reshard_summary
+
+    cfg = _cfg(num_shards=2)
+    spec = _spec_for(agg_cls, cfg)
+    rerouted = reshard_summary(spec.initial_shard_state(cfg, 2), cfg, 2, 8)
+    fresh = spec.initial_shard_state(cfg, 8)
+    for got, exp in zip(_leaves(rerouted), _leaves(fresh)):
+        assert np.array_equal(got, exp)
+
+
+def test_reshard_validates_geometry():
+    from gelly_streaming_tpu.core.sharded_state import reshard_summary
+
+    cfg = _cfg(num_shards=4)
+    spec = _spec_for(ConnectedComponents, cfg)
+    blocks = spec.initial_shard_state(cfg, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        reshard_summary(blocks, cfg, 4, 3)
+    with pytest.raises(ValueError, match="positive"):
+        reshard_summary(blocks, cfg, 4, 0)
+    with pytest.raises(ValueError, match="owner-block layout"):
+        reshard_summary(blocks, cfg, 8, 4)  # leaves are [4, ...], not [8, ...]
+
+
 def test_sharded_state_env_and_config_resolution(monkeypatch):
     from gelly_streaming_tpu.core.sharded_state import resolve_sharded_state
 
