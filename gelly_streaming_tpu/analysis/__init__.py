@@ -36,6 +36,31 @@ Passes (one module each, registered on import):
                          sites: streaming-step kernels must reconcile via
                          delta buffers (the owner-sharded summary plane's
                          O(C/S + delta) comms invariant, ISSUE 4).
+  #6 ``holds-lock``      NOHOLD/HELDLOCK — interprocedural lock contracts:
+                         a ``# holds-lock: <lock>`` function may only be
+                         called with the lock held, and its ``# guarded-by:``
+                         accesses are checked against the declared held set
+                         (callgraph.py + concurrency.py; pass #3 consumes
+                         the same annotation engine).
+  #7 ``lock-order``      LOCKORDER — cycles in the global lock-acquisition
+                         graph (edge A->B when B is acquired while A is
+                         held, propagated through the call graph), with
+                         sanctioned orders declared via ``# lock-order:``
+                         and re-entrant RLock self-edges exempt.
+  #8 ``check-then-act``  TOCTOU — a read of ``# guarded-by:`` state in one
+                         lock region feeding a conditional that guards a
+                         write to the same state in a DIFFERENT (or absent)
+                         region of the same function (the tenant-cap steal
+                         shape fixed in PR 7).
+  #9 ``test-discipline`` NOTIMEOUT — every ``def test_*`` that drives
+                         threads, sockets, or subprocesses must carry
+                         ``@pytest.mark.timeout_cap`` (run over tests/ by
+                         the tier-1 gate; inert on the package tree).
+
+Passes #6-#8 are PROJECT passes: they see every scanned file at once
+(``ProjectPass.run_project``) because a lock hierarchy only exists across
+modules; on a single-file ``analyze_source``/``analyze_file`` call they
+run with that file as the whole project.
 
 Finding format: ``file:line: [PASS/CODE] message``.
 
@@ -56,7 +81,7 @@ import json
 import os
 import re
 import tokenize
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 
@@ -69,6 +94,10 @@ class Finding:
     pass_name: str
     code: str
     message: str
+    #: True when a ``# graft: disable=`` comment or the baseline silenced
+    #: it — only surfaced when the caller asked to keep suppressed findings
+    #: (the ``--format json`` schema carries the flag)
+    suppressed: bool = False
 
     def format(self) -> str:
         return f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] {self.message}"
@@ -161,6 +190,21 @@ class Pass:
         raise NotImplementedError
 
 
+class ProjectPass(Pass):
+    """A pass that needs the WHOLE scanned file set at once (lock
+    hierarchies span modules).  ``analyze_paths`` runs it exactly once
+    over the full set; single-file entry points run it with that file as
+    the project."""
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        from gelly_streaming_tpu.analysis import callgraph
+
+        return self.run_project(callgraph.Project([sf]))
+
+    def run_project(self, project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 _REGISTRY: Dict[str, Pass] = {}
 
 
@@ -183,8 +227,25 @@ def load_passes() -> Dict[str, Pass]:
     from gelly_streaming_tpu.analysis import locks  # noqa: F401
     from gelly_streaming_tpu.analysis import trace_safety  # noqa: F401
     from gelly_streaming_tpu.analysis import collectives  # noqa: F401
+    from gelly_streaming_tpu.analysis import concurrency  # noqa: F401
+    from gelly_streaming_tpu.analysis import testdiscipline  # noqa: F401
 
     return dict(_REGISTRY)
+
+
+def _filter_suppressed(
+    findings: Iterable[Finding],
+    sf: SourceFile,
+    keep_suppressed: bool,
+) -> List[Finding]:
+    out: List[Finding] = []
+    for f in findings:
+        if sf.suppressed(f.line, f.code):
+            if keep_suppressed:
+                out.append(replace(f, suppressed=True))
+        else:
+            out.append(f)
+    return out
 
 
 def analyze_source(
@@ -192,9 +253,11 @@ def analyze_source(
     filename: str = "<string>",
     passes: Optional[Sequence[Pass]] = None,
     path: Optional[str] = None,
+    keep_suppressed: bool = False,
 ) -> List[Finding]:
     """Run passes over one module's source; suppressed findings are dropped
-    here so no caller ever sees them."""
+    here (or flagged, with ``keep_suppressed``) so no caller ever acts on
+    them by accident."""
     if passes is None:
         passes = list(load_passes().values())
     sf = SourceFile(text, path if path is not None else filename, filename)
@@ -202,9 +265,7 @@ def analyze_source(
         return [sf.finding(1, "analysis", "PARSE", sf.parse_error)]
     out: List[Finding] = []
     for p in passes:
-        for f in p.run(sf):
-            if not sf.suppressed(f.line, f.code):
-                out.append(f)
+        out.extend(_filter_suppressed(p.run(sf), sf, keep_suppressed))
     out.sort(key=lambda f: (f.path, f.line, f.code))
     return out
 
@@ -213,6 +274,7 @@ def analyze_file(
     path: str,
     passes: Optional[Sequence[Pass]] = None,
     root: Optional[str] = None,
+    keep_suppressed: bool = False,
 ) -> List[Finding]:
     display = path
     if root is not None:
@@ -220,7 +282,10 @@ def analyze_file(
         if not rel.startswith(".."):
             display = rel
     with open(path) as f:
-        return analyze_source(f.read(), display, passes, path=path)
+        return analyze_source(
+            f.read(), display, passes, path=path,
+            keep_suppressed=keep_suppressed,
+        )
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
@@ -234,16 +299,98 @@ def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
             yield path
 
 
+def _display_for(path: str, root: Optional[str]) -> str:
+    if root is None:
+        return path
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    return path if rel.startswith("..") else rel
+
+
+def _analyze_file_task(args) -> List[Finding]:
+    """Process-pool worker for ``--jobs``: re-resolves passes by name (pass
+    objects stay in-process) and runs the per-file passes over one file."""
+    path, root, pass_names, keep_suppressed = args
+    registry = load_passes()
+    passes = [
+        registry[n]
+        for n in pass_names
+        if not isinstance(registry[n], ProjectPass)
+    ]
+    return analyze_file(
+        path, passes, root=root, keep_suppressed=keep_suppressed
+    )
+
+
 def analyze_paths(
     paths: Iterable[str],
     passes: Optional[Sequence[Pass]] = None,
     root: Optional[str] = None,
+    jobs: int = 1,
+    keep_suppressed: bool = False,
 ) -> List[Finding]:
+    """Scan files/directories.  Per-file passes run per file (optionally
+    across ``jobs`` worker processes); project passes run ONCE over the
+    whole parsed file set, which is what makes a cross-module lock cycle
+    visible at all."""
     if passes is None:
         passes = list(load_passes().values())
+    file_passes = [p for p in passes if not isinstance(p, ProjectPass)]
+    project_passes = [p for p in passes if isinstance(p, ProjectPass)]
+    files = list(iter_python_files(paths))
     findings: List[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(analyze_file(path, passes, root=root))
+    parsed: Optional[List[SourceFile]] = None
+    if jobs > 1 and len(files) > 1:
+        import concurrent.futures
+
+        tasks = [
+            (path, root, [p.name for p in file_passes], keep_suppressed)
+            for path in files
+        ]
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(jobs, len(files))
+        ) as pool:
+            for batch in pool.map(_analyze_file_task, tasks):
+                findings.extend(batch)
+    else:
+        # serial path: parse each file ONCE and reuse the SourceFiles for
+        # the project passes below
+        parsed = []
+        for path in files:
+            with open(path) as f:
+                sf = SourceFile(f.read(), path, _display_for(path, root))
+            parsed.append(sf)
+            if sf.parse_error is not None:
+                findings.append(
+                    sf.finding(1, "analysis", "PARSE", sf.parse_error)
+                )
+                continue
+            for p in file_passes:
+                findings.extend(
+                    _filter_suppressed(p.run(sf), sf, keep_suppressed)
+                )
+    if project_passes:
+        from gelly_streaming_tpu.analysis import callgraph
+
+        if parsed is None:  # --jobs: the workers parsed their own copies
+            parsed = []
+            for path in files:
+                with open(path) as f:
+                    parsed.append(
+                        SourceFile(f.read(), path, _display_for(path, root))
+                    )
+        sfs = [sf for sf in parsed if sf.tree is not None]
+        by_path = {sf.display_path: sf for sf in sfs}
+        project = callgraph.Project(sfs)
+        for p in project_passes:
+            for f in p.run_project(project):
+                sf = by_path.get(f.path)
+                if sf is None:
+                    findings.append(f)
+                    continue
+                findings.extend(
+                    _filter_suppressed([f], sf, keep_suppressed)
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings
 
 

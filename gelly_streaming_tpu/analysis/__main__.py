@@ -75,6 +75,25 @@ def main(argv=None) -> int:
         "--list-passes", action="store_true", help="list passes and exit"
     )
     parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format: 'text' (default, file:line: [PASS/CODE] "
+        "message) or 'json' — a stable machine-readable schema "
+        "{findings: [{file,line,pass,code,message,suppressed}], summary} "
+        "where comment-suppressed and baseline-grandfathered findings "
+        "appear with suppressed=true and do not fail the run",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="worker processes for per-file scanning (project passes — "
+        "lock-order and friends — always run once in-process over the "
+        "whole file set); 2 keeps the full-tree gate fast on a 2-core "
+        "host",
+    )
+    parser.add_argument(
         "-q", "--quiet", action="store_true", help="suppress the summary line"
     )
     args = parser.parse_args(argv)
@@ -102,7 +121,16 @@ def main(argv=None) -> int:
         return 2
 
     root = os.path.dirname(analysis.package_root())
-    findings = analysis.analyze_paths(paths, selected, root=root)
+    as_json = args.format == "json"
+    findings = analysis.analyze_paths(
+        paths,
+        selected,
+        root=root,
+        jobs=max(1, args.jobs),
+        keep_suppressed=as_json,
+    )
+    comment_suppressed = [f for f in findings if f.suppressed]
+    findings = [f for f in findings if not f.suppressed]
 
     if args.write_baseline:
         analysis.write_baseline(findings, args.baseline)
@@ -117,6 +145,42 @@ def main(argv=None) -> int:
     if not args.no_baseline and os.path.exists(args.baseline):
         baseline = analysis.load_baseline(args.baseline)
         findings, grandfathered = analysis.apply_baseline(findings, baseline)
+
+    if as_json:
+        import json
+        from dataclasses import replace
+
+        rows = sorted(
+            findings
+            + [replace(f, suppressed=True) for f in grandfathered]
+            + comment_suppressed,
+            key=lambda f: (f.path, f.line, f.code),
+        )
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "file": f.path,
+                            "line": f.line,
+                            "pass": f.pass_name,
+                            "code": f.code,
+                            "message": f.message,
+                            "suppressed": f.suppressed,
+                        }
+                        for f in rows
+                    ],
+                    "summary": {
+                        "new": len(findings),
+                        "grandfathered": len(grandfathered),
+                        "suppressed": len(comment_suppressed),
+                        "files": len(set(f.path for f in findings)),
+                    },
+                },
+                indent=2,
+            )
+        )
+        return 1 if findings else 0
 
     for f in findings:
         print(f.format())

@@ -1,0 +1,1025 @@
+"""Interprocedural engine: per-module call graphs, lock identity, and the
+acquisition-order graph the concurrency passes (#6-#8) share.
+
+Graftcheck's original lock pass (#3) is strictly intraprocedural — a
+``with self._lock:`` is only visible in the function body that contains
+it, so every helper called under a lock is invisible, and the runtime's
+two interacting lock hierarchies (the manager's admission RLock, the
+server's ``_admission`` serialization, the metrics/events leaf locks)
+cannot be checked as hierarchies at all.  This module builds the shared
+model those checks need:
+
+* a FUNCTION INDEX per module (methods keyed by class, module functions
+  by name) with call sites resolved by name: ``self.m()`` within the
+  class, bare ``f()`` within the module, ``alias.f()`` through imports
+  of analyzed modules, ``self.attr.m()`` through ``__init__``
+  parameter/constructor type annotations, and ``mod.f().m()`` through
+  return-type annotations (``events.journal().emit`` resolves to
+  ``EventJournal.emit``);
+* LOCK IDENTITY: ``module.Class.attr`` for instance locks,
+  ``module.attr`` for module globals, with ``# lock-alias:`` unification
+  (runtime/job.py's ``_lock`` IS the manager's RLock, shared by
+  reference — without the alias the graph would see two locks and miss
+  that edges through either are re-entrant on the other) and RLock
+  detection from declarations and parameter annotations;
+* the ACQUISITION GRAPH: edge A -> B wherever B is acquired while A is
+  held, propagated through the call graph (a function's transitive
+  acquisition set flows up to every call site that holds locks), each
+  edge carrying a representative ``file:line`` path for reporting.
+
+Annotation grammar owned here (pass #3 consumes the same parser so the
+intra- and interprocedural layers cannot disagree):
+
+* ``# holds-lock: <lock>[, <lock>]`` — on a ``def`` line, its
+  decorators, or the line directly above: the function must only be
+  called with those locks held.  Bare names resolve to ``self.<name>``
+  for methods and the module global for functions; dotted
+  ``module.attr`` / ``module.Class.attr`` terms name any project lock.
+* ``# lock-order: A < B [< C ...]`` — module-level declaration of the
+  sanctioned acquisition order; each relation becomes a virtual edge in
+  the acquisition graph, so a single real edge that CONTRADICTS a
+  declared order closes a cycle and is reported without needing the
+  reverse acquisition to exist in code.
+* ``# lock-alias: <term>`` — trailing comment on a lock-attribute
+  assignment (``self._lock = manager_lock``): this attribute is the
+  SAME lock object as ``<term>``; the graph unifies the two identities.
+
+Deliberate limits: resolution is by name and annotation only (no data
+flow through containers or callbacks), inheritance is not searched, and
+lambdas/nested defs never inherit the enclosing function's held set —
+they run on arbitrary threads at arbitrary times.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from gelly_streaming_tpu import analysis
+
+_HOLDS_RE = re.compile(
+    r"#\s*holds-lock:\s*([A-Za-z_][\w.]*(?:\s*,\s*[A-Za-z_][\w.]*)*)"
+)
+_ORDER_RE = re.compile(r"#\s*lock-order:\s*([^#]*)")
+_ALIAS_RE = re.compile(r"#\s*lock-alias:\s*([A-Za-z_][\w.]*)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_SINGLE_RE = re.compile(r"#\s*single-thread:")
+
+
+@dataclass(frozen=True)
+class Lock:
+    """One lock identity: ``module.Class.attr`` (instance) or
+    ``module.attr`` (module global)."""
+
+    module: str
+    cls: Optional[str]
+    attr: str
+
+    def display(self) -> str:
+        if self.cls:
+            return f"{self.module}.{self.cls}.{self.attr}"
+        return f"{self.module}.{self.attr}"
+
+
+@dataclass
+class FuncInfo:
+    """One indexed function/method."""
+
+    module: "ModuleInfo"
+    cls: Optional[str]
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    holds_raw: Tuple[str, ...] = ()
+    single_thread: bool = False
+
+    def qualname(self) -> str:
+        if self.cls:
+            return f"{self.module.name}.{self.cls}.{self.name}"
+        return f"{self.module.name}.{self.name}"
+
+
+def module_name_for(display_path: str) -> str:
+    """``gelly_streaming_tpu/utils/metrics.py`` -> ``metrics`` (package
+    ``__init__`` files take the package directory's name)."""
+    base = os.path.basename(display_path)
+    if base.endswith(".py"):
+        base = base[:-3]
+    if base == "__init__":
+        parent = os.path.basename(os.path.dirname(display_path))
+        return parent or base
+    return base
+
+
+def holds_decl_names(
+    sf: "analysis.SourceFile", node: ast.AST
+) -> Tuple[str, ...]:
+    """Raw ``# holds-lock:`` names on a def line, its decorators, or the
+    line directly above (same placement rule as ``# single-thread:``) —
+    shared with pass #3 so the two layers read one grammar."""
+    first = min(
+        [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
+    )
+    body = getattr(node, "body", None)
+    last = body[0].lineno - 1 if body else node.lineno
+    names: List[str] = []
+    for i in range(first - 1, last + 1):
+        m = _HOLDS_RE.search(sf.comment(i))
+        if m:
+            names.extend(n.strip() for n in m.group(1).split(","))
+    return tuple(n for n in names if n)
+
+
+def single_thread_marked(sf: "analysis.SourceFile", node: ast.AST) -> bool:
+    first = min(
+        [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
+    )
+    for i in range(first - 1, node.body[0].lineno):
+        if _SINGLE_RE.search(sf.comment(i)):
+            return True
+    return False
+
+
+def _ann_text(a: Optional[ast.AST]) -> str:
+    """Best-effort flat text of an annotation (handles string annotations
+    like ``"StreamServer"``)."""
+    if a is None:
+        return ""
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value
+    try:
+        return ast.unparse(a)
+    except Exception:  # pragma: no cover — malformed annotation
+        return ""
+
+
+def _ann_class_name(a: Optional[ast.AST]) -> Optional[str]:
+    """The class a parameter annotation names, as a bare name
+    (``JobManager``, ``"StreamServer"``, ``Optional[Job]`` -> ``Job``)."""
+    text = _ann_text(a)
+    if not text:
+        return None
+    # strip Optional[...] / quotes / dotted prefixes; keep the last
+    # identifier that starts with an uppercase letter
+    idents = re.findall(r"[A-Za-z_][A-Za-z0-9_]*", text)
+    for name in reversed(idents):
+        if name[0].isupper() and name not in ("Optional", "None", "List",
+                                              "Dict", "Tuple", "Set"):
+            return name
+    return None
+
+
+def collect_guards(
+    sf: "analysis.SourceFile", tree: Optional[ast.AST] = None
+) -> Tuple[Dict[Tuple[str, str], str], Dict[str, str], Set[int]]:
+    """``# guarded-by:`` declarations: (class, attr) -> lock attr name,
+    global name -> lock global name, and the declaration lines themselves
+    (exempt from access checks).  Shared by passes #3, #6, and #8."""
+    attr_guards: Dict[Tuple[str, str], str] = {}
+    global_guards: Dict[str, str] = {}
+    decl_lines: Set[int] = set()
+
+    def guard_on(start: int, end: int) -> Optional[str]:
+        for i in range(start, end + 1):
+            m = _GUARDED_RE.search(sf.comment(i))
+            if m:
+                return m.group(1)
+        return None
+
+    def walk(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+                continue
+            if isinstance(child, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                end = getattr(child, "end_lineno", None) or child.lineno
+                lock = guard_on(child.lineno, end)
+                if lock is not None:
+                    targets = (
+                        child.targets
+                        if isinstance(child, ast.Assign)
+                        else [child.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and cls is not None
+                        ):
+                            attr_guards[(cls, t.attr)] = lock
+                            decl_lines.update(range(child.lineno, end + 1))
+                        elif isinstance(t, ast.Name) and cls is None:
+                            global_guards[t.id] = lock
+                            decl_lines.update(range(child.lineno, end + 1))
+            walk(child, cls)
+
+    walk(tree if tree is not None else sf.tree, None)
+    return attr_guards, global_guards, decl_lines
+
+
+class ModuleInfo:
+    """The per-module model: functions, classes, imports, lock
+    declarations, attribute types, and annotations."""
+
+    def __init__(self, sf: "analysis.SourceFile"):
+        self.sf = sf
+        self.name = module_name_for(sf.display_path)
+        self.path = sf.display_path
+        #: (cls-or-None, funcname) -> FuncInfo (top-level defs + methods)
+        self.functions: Dict[Tuple[Optional[str], str], FuncInfo] = {}
+        #: nested defs, analyzed for acquisitions but not call-resolvable
+        self.nested: List[FuncInfo] = []
+        self.classes: Dict[str, ast.ClassDef] = {}
+        #: local alias -> analyzed-module basename candidate (resolved at
+        #: Project level), from ``import a.b.c as m`` / ``from a.b import m``
+        self.import_aliases: Dict[str, str] = {}
+        #: imported class name -> itself (resolved via Project.class_index)
+        self.imported_names: Set[str] = set()
+        #: (cls-or-None, attr) declared/annotated re-entrant
+        self.rlocks: Set[Tuple[Optional[str], str]] = set()
+        #: (cls, attr) -> bare class name the attribute holds
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        #: funcname/(cls,funcname) -> return-annotation class name
+        self.return_types: Dict[Tuple[Optional[str], str], str] = {}
+        #: (cls, attr) -> raw alias term from ``# lock-alias:``
+        self.aliases: Dict[Tuple[Optional[str], str], str] = {}
+        #: declared order chains: list of (lineno, [term, term, ...])
+        self.orders: List[Tuple[int, List[str]]] = []
+        g = collect_guards(sf)
+        self.attr_guards, self.global_guards, self.guard_decl_lines = g
+        self._index()
+        self._parse_orders()
+
+    # -- model construction ------------------------------------------------
+
+    def _index(self) -> None:
+        tree = self.sf.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        # `import a.b.c as m` binds m to the LEAF module
+                        self.import_aliases[a.asname] = a.name.split(".")[-1]
+                    else:
+                        # `import a.b.c` binds only the ROOT package name
+                        root = a.name.split(".")[0]
+                        self.import_aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    alias = a.asname or a.name
+                    self.import_aliases.setdefault(alias, a.name)
+                    self.imported_names.add(alias)
+        for child in ast.iter_child_nodes(tree):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(None, child)
+            elif isinstance(child, ast.ClassDef):
+                self.classes[child.name] = child
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._add_func(child.name, sub)
+            elif isinstance(child, ast.Assign):
+                self._scan_lock_decl(None, child)
+        for cls_name, cls_node in self.classes.items():
+            for sub in ast.iter_child_nodes(cls_node):
+                if (
+                    isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub.name == "__init__"
+                ):
+                    self._scan_init(cls_name, sub)
+
+    def _add_func(self, cls: Optional[str], node) -> None:
+        fi = FuncInfo(
+            self,
+            cls,
+            node.name,
+            node,
+            holds_raw=holds_decl_names(self.sf, node),
+            single_thread=single_thread_marked(self.sf, node),
+        )
+        self.functions[(cls, node.name)] = fi
+        ret = _ann_class_name(node.returns)
+        if ret is not None:
+            self.return_types[(cls, node.name)] = ret
+        # nested defs: indexed for body analysis only
+        for inner in ast.walk(node):
+            if inner is not node and isinstance(
+                inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.nested.append(
+                    FuncInfo(
+                        self,
+                        cls,
+                        f"{node.name}.<locals>.{inner.name}",
+                        inner,
+                        holds_raw=holds_decl_names(self.sf, inner),
+                        single_thread=single_thread_marked(self.sf, inner),
+                    )
+                )
+
+    def _scan_lock_decl(self, cls: Optional[str], node: ast.Assign) -> None:
+        src = _ann_text(node.value)
+        is_rlock = "RLock" in src
+        if "Lock" not in src and "Condition" not in src and not is_rlock:
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name) and cls is None and is_rlock:
+                self.rlocks.add((None, t.id))
+
+    def _scan_init(self, cls: str, init) -> None:
+        #: param name -> (class name, is_rlock)
+        params: Dict[str, Tuple[Optional[str], bool]] = {}
+        args = init.args
+        for a in list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs
+        ):
+            text = _ann_text(a.annotation)
+            params[a.arg] = (_ann_class_name(a.annotation), "RLock" in text)
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                val = node.value
+                end = getattr(node, "end_lineno", None) or node.lineno
+                for i in range(node.lineno, end + 1):
+                    m = _ALIAS_RE.search(self.sf.comment(i))
+                    if m:
+                        self.aliases[(cls, t.attr)] = m.group(1)
+                if "RLock" in _ann_text(val):
+                    self.rlocks.add((cls, t.attr))
+                if isinstance(val, ast.Name) and val.id in params:
+                    cname, rlock = params[val.id]
+                    if rlock:
+                        self.rlocks.add((cls, t.attr))
+                    if cname is not None:
+                        self.attr_types[(cls, t.attr)] = cname
+                elif isinstance(val, ast.Call) and isinstance(
+                    val.func, ast.Name
+                ):
+                    # direct construction: self.x = Foo(...)
+                    if val.func.id[0:1].isupper():
+                        self.attr_types[(cls, t.attr)] = val.func.id
+
+    def _parse_orders(self) -> None:
+        for lineno, comment in self.sf.comments.items():
+            m = _ORDER_RE.search(comment)
+            if m:
+                terms = [t.strip() for t in m.group(1).split("<")]
+                terms = [t for t in terms if t]
+                if len(terms) >= 2:
+                    self.orders.append((lineno, terms))
+
+
+class Project:
+    """The cross-module view: module registry, class index, lock-term
+    resolution, alias unification, and call resolution."""
+
+    def __init__(self, sfs: Sequence["analysis.SourceFile"]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.module_list: List[ModuleInfo] = []
+        for sf in sfs:
+            if sf.tree is None:
+                continue
+            mi = ModuleInfo(sf)
+            self.module_list.append(mi)
+            # first wins on basename collision (none in the package today)
+            self.modules.setdefault(mi.name, mi)
+        #: bare class name -> owning module (unique names only)
+        self.class_index: Dict[str, ModuleInfo] = {}
+        dup: Set[str] = set()
+        for mi in self.module_list:
+            for cname in mi.classes:
+                if cname in self.class_index and self.class_index[cname] is not mi:
+                    dup.add(cname)
+                else:
+                    self.class_index.setdefault(cname, mi)
+        for cname in dup:
+            self.class_index.pop(cname, None)
+        #: alias unification map, built lazily
+        self._alias_map: Optional[Dict[Lock, Lock]] = None
+        #: id(FuncInfo) -> shared _AcqWalker (see ``walker``)
+        self._walkers: Dict[int, "_AcqWalker"] = {}
+
+    # -- lock identity -----------------------------------------------------
+
+    def _build_alias_map(self) -> Dict[Lock, Lock]:
+        amap: Dict[Lock, Lock] = {}
+        for mi in self.module_list:
+            for (cls, attr), term in mi.aliases.items():
+                src = Lock(mi.name, cls, attr)
+                targets = self.resolve_term(term, mi)
+                if len(targets) == 1:
+                    amap[src] = targets[0]
+        # collapse chains (bounded: alias-of-alias)
+        for _ in range(4):
+            changed = False
+            for src, dst in list(amap.items()):
+                if dst in amap and amap[dst] != dst:
+                    amap[src] = amap[dst]
+                    changed = True
+            if not changed:
+                break
+        return amap
+
+    def canonical(self, lock: Lock) -> Lock:
+        if self._alias_map is None:
+            self._alias_map = self._build_alias_map()
+        return self._alias_map.get(lock, lock)
+
+    def is_rlock(self, lock: Lock) -> bool:
+        lock = self.canonical(lock)
+        mi = self.modules.get(lock.module)
+        if mi is None:
+            return False
+        return (lock.cls, lock.attr) in mi.rlocks
+
+    def resolve_term(
+        self, term: str, home: Optional[ModuleInfo] = None
+    ) -> List[Lock]:
+        """A dotted lock term from an annotation -> matching identities.
+
+        ``mod.Class.attr`` is exact; ``mod.attr`` matches that module's
+        global OR any class's instance lock with that attr (all of them
+        when ambiguous); a bare name resolves in ``home``.
+        """
+        parts = term.split(".")
+        if len(parts) == 3:
+            return [Lock(parts[0], parts[1], parts[2])]
+        if len(parts) == 2:
+            mod, attr = parts
+            mi = self.modules.get(mod)
+            if mi is None:
+                return [Lock(mod, None, attr)]
+            out = [
+                Lock(mod, cls, attr)
+                for cls in mi.classes
+                if self._class_has_attr_lock(mi, cls, attr)
+            ]
+            if self._module_has_global(mi, attr) or not out:
+                out.append(Lock(mod, None, attr))
+            return out
+        if len(parts) == 1 and home is not None:
+            return [Lock(home.name, None, parts[0])]
+        return []
+
+    @staticmethod
+    def _class_has_attr_lock(mi: ModuleInfo, cls: str, attr: str) -> bool:
+        node = mi.classes.get(cls)
+        if node is None:
+            return False
+        return any(
+            isinstance(sub, ast.Attribute)
+            and sub.attr == attr
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+            for sub in ast.walk(node)
+        )
+
+    @staticmethod
+    def _module_has_global(mi: ModuleInfo, attr: str) -> bool:
+        tree = mi.sf.tree
+        for child in ast.iter_child_nodes(tree):
+            if isinstance(child, ast.Assign):
+                for t in child.targets:
+                    if isinstance(t, ast.Name) and t.id == attr:
+                        return True
+        return False
+
+    # -- expression -> lock ------------------------------------------------
+
+    def lock_from_expr(
+        self, mi: ModuleInfo, cls: Optional[str], ctx: ast.AST
+    ) -> Optional[Lock]:
+        """The lock a ``with`` context expression names, or None when it
+        cannot be identified (``with self._q.mutex:`` on an untyped
+        attribute, ``with open(...):``, ...)."""
+        if isinstance(ctx, ast.Name):
+            return Lock(mi.name, None, ctx.id)
+        if isinstance(ctx, ast.Attribute):
+            base = ctx.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    return Lock(mi.name, cls, ctx.attr)
+                if base.id in mi.import_aliases:
+                    target = mi.import_aliases[base.id]
+                    if target in self.modules:
+                        return Lock(target, None, ctx.attr)
+                return None
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and cls is not None
+            ):
+                cname = mi.attr_types.get((cls, base.attr))
+                if cname is not None:
+                    owner = self.class_index.get(cname)
+                    if owner is not None:
+                        return Lock(owner.name, cname, ctx.attr)
+        return None
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(
+        self,
+        mi: ModuleInfo,
+        cls: Optional[str],
+        call: ast.Call,
+        param_types: Optional[Dict[str, str]] = None,
+    ) -> Optional[FuncInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            # bare f() -> module function; Bare Class() -> __init__
+            fi = mi.functions.get((None, func.id))
+            if fi is not None:
+                return fi
+            owner = None
+            if func.id in mi.classes:
+                owner = mi
+            elif func.id in mi.imported_names:
+                owner = self.class_index.get(func.id)
+            if owner is not None:
+                return owner.functions.get((func.id, "__init__"))
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        meth = func.attr
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls is not None:
+                return mi.functions.get((cls, meth))
+            if base.id in mi.import_aliases:
+                target = self.modules.get(mi.import_aliases[base.id])
+                if target is not None:
+                    return target.functions.get((None, meth))
+            if param_types and base.id in param_types:
+                return self._method_of(param_types[base.id], meth)
+            if base.id in mi.classes or base.id in mi.imported_names:
+                owner = (
+                    mi if base.id in mi.classes
+                    else self.class_index.get(base.id)
+                )
+                if owner is not None:
+                    return owner.functions.get((base.id, meth))
+            return None
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+            and cls is not None
+        ):
+            cname = mi.attr_types.get((cls, base.attr))
+            if cname is not None:
+                return self._method_of(cname, meth)
+            return None
+        if isinstance(base, ast.Call):
+            # chained: mod.f().m() — resolve through f's return annotation
+            inner = self.resolve_call(mi, cls, base, param_types)
+            if inner is not None:
+                ret = inner.module.return_types.get((inner.cls, inner.name))
+                if ret is not None:
+                    return self._method_of(ret, meth)
+        return None
+
+    def _method_of(self, cname: str, meth: str) -> Optional[FuncInfo]:
+        owner = self.class_index.get(cname)
+        if owner is None:
+            return None
+        return owner.functions.get((cname, meth))
+
+    # -- per-function helpers ----------------------------------------------
+
+    def param_types_of(self, fi: FuncInfo) -> Dict[str, str]:
+        """Parameter name -> annotated class name (``job: Job``)."""
+        out: Dict[str, str] = {}
+        args = fi.node.args
+        for a in list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs
+        ):
+            cname = _ann_class_name(a.annotation)
+            if cname is not None and cname in self.class_index:
+                out[a.arg] = cname
+        return out
+
+    def entry_holds(self, fi: FuncInfo) -> List[Lock]:
+        """Canonical locks a ``# holds-lock:`` declaration guarantees held
+        at entry."""
+        out: List[Lock] = []
+        for raw in fi.holds_raw:
+            if "." in raw:
+                matches = self.resolve_term(raw, fi.module)
+                out.extend(self.canonical(m) for m in matches)
+            else:
+                lock = Lock(fi.module.name, fi.cls, raw)
+                out.append(self.canonical(lock))
+        seen: Set[Lock] = set()
+        uniq = []
+        for lk in out:
+            if lk not in seen:
+                seen.add(lk)
+                uniq.append(lk)
+        return uniq
+
+    def all_functions(self) -> Iterable[FuncInfo]:
+        for mi in self.module_list:
+            yield from mi.functions.values()
+            yield from mi.nested
+
+    def walker(self, fi: FuncInfo) -> "_AcqWalker":
+        """Per-function body walk, built once and shared across passes
+        (holds-lock and lock-order both need the same call/held model)."""
+        cached = self._walkers.get(id(fi))
+        if cached is None:
+            cached = self._walkers[id(fi)] = _AcqWalker(self, fi)
+        return cached
+
+
+# ---------------------------------------------------------------------------
+# Acquisition graph
+
+
+@dataclass
+class Edge:
+    """A -> B: ``held`` was held when ``acquired`` was taken."""
+
+    held: Lock
+    acquired: Lock
+    path: str  # display path of the file the edge anchors to
+    line: int
+    #: human chain: how the acquisition is reached from the hold site
+    via: Tuple[str, ...] = ()
+    declared: bool = False
+
+
+class _AcqWalker:
+    """One function's body walk: acquisition edges, local acquisitions
+    (lock -> representative site), and call sites with held snapshots."""
+
+    def __init__(self, project: Project, fi: FuncInfo):
+        self.project = project
+        self.fi = fi
+        self.mi = fi.module
+        self.param_types = project.param_types_of(fi)
+        self.edges: List[Edge] = []
+        #: lock -> (line, chain) of its first local acquisition
+        self.local_acq: Dict[Lock, Tuple[int, Tuple[str, ...]]] = {}
+        #: (callee FuncInfo, line, held snapshot)
+        self.calls: List[Tuple[FuncInfo, int, Tuple[Lock, ...]]] = []
+        #: guarded-state touches: ("attr"|"global", name, line, held)
+        self.accesses: List[Tuple[str, str, int, Tuple[Lock, ...]]] = []
+        self._walk_body(fi.node.body, list(project.entry_holds(fi)))
+
+    def _site(self, line: int) -> str:
+        return f"{self.mi.path}:{line}"
+
+    def _walk_body(self, body, held: List[Lock]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, node: ast.AST, held: List[Lock]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are analyzed as their own functions
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                self._scan_calls(item.context_expr, inner)
+                lock = self.project.lock_from_expr(
+                    self.mi, self.fi.cls, item.context_expr
+                )
+                if lock is None:
+                    continue
+                lock = self.project.canonical(lock)
+                if lock in inner:
+                    if not self.project.is_rlock(lock):
+                        self.edges.append(
+                            Edge(
+                                lock,
+                                lock,
+                                self.mi.path,
+                                node.lineno,
+                                (f"re-acquired at {self._site(node.lineno)}",),
+                            )
+                        )
+                    continue
+                for h in inner:
+                    self.edges.append(
+                        Edge(
+                            h,
+                            lock,
+                            self.mi.path,
+                            node.lineno,
+                            (
+                                f"{self._site(node.lineno)} "
+                                f"with {lock.display()}",
+                            ),
+                        )
+                    )
+                self.local_acq.setdefault(
+                    lock,
+                    (
+                        node.lineno,
+                        (
+                            f"{self._site(node.lineno)} "
+                            f"with {lock.display()}",
+                        ),
+                    ),
+                )
+                inner.append(lock)
+            self._walk_body(node.body, inner)
+            return
+        # statements: scan expressions for calls, then recurse into blocks
+        for name in ("test", "iter", "value", "exc", "msg", "target"):
+            sub = getattr(node, name, None)
+            if isinstance(sub, ast.expr):
+                self._scan_calls(sub, held)
+        for t in getattr(node, "targets", []) or []:
+            if isinstance(t, ast.expr):
+                self._scan_calls(t, held)
+        for name in ("body", "orelse", "finalbody"):
+            block = getattr(node, name, None)
+            if isinstance(block, list):
+                for sub in block:
+                    if isinstance(sub, ast.stmt):
+                        self._walk_stmt(sub, held)
+        for handler in getattr(node, "handlers", []) or []:
+            if isinstance(handler, ast.ExceptHandler):
+                self._walk_body(handler.body, held)
+        for case in getattr(node, "cases", []) or []:
+            body = getattr(case, "body", None)
+            if isinstance(body, list):
+                self._walk_body(body, held)
+
+    def _scan_calls(self, expr: ast.AST, held: List[Lock]) -> None:
+        snapshot = tuple(held)
+        stack: List[ast.AST] = [expr]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.Lambda):
+                continue  # deferred execution: held set does not apply
+            if isinstance(sub, ast.Call):
+                target = self.project.resolve_call(
+                    self.mi, self.fi.cls, sub, self.param_types
+                )
+                if target is not None:
+                    self.calls.append((target, sub.lineno, snapshot))
+            elif (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and self.fi.cls is not None
+                and (self.fi.cls, sub.attr) in self.mi.attr_guards
+            ):
+                self.accesses.append(("attr", sub.attr, sub.lineno, snapshot))
+            elif (
+                isinstance(sub, ast.Name)
+                and sub.id in self.mi.global_guards
+                and isinstance(sub.ctx, (ast.Load, ast.Store, ast.Del))
+            ):
+                self.accesses.append(("global", sub.id, sub.lineno, snapshot))
+            stack.extend(ast.iter_child_nodes(sub))
+
+
+class AcquisitionGraph:
+    """The project-wide lock graph with interprocedural propagation."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.edges: List[Edge] = []
+        self._functions = list(project.all_functions())
+        for fi in self._functions:
+            self.edges.extend(project.walker(fi).edges)
+        self._acq = self._acq_fixpoint()
+        for fi in self._functions:
+            self._propagate(fi)
+        # declared orders become virtual edges: a real edge contradicting
+        # a declaration closes a cycle without the reverse code path
+        for mi in project.module_list:
+            for lineno, terms in mi.orders:
+                resolved = [
+                    [project.canonical(lk) for lk in project.resolve_term(t, mi)]
+                    for t in terms
+                ]
+                for a_set, b_set in zip(resolved, resolved[1:]):
+                    for a in a_set:
+                        for b in b_set:
+                            if a != b:
+                                self.edges.append(
+                                    Edge(
+                                        a,
+                                        b,
+                                        mi.path,
+                                        lineno,
+                                        (f"declared at {mi.path}:{lineno}",),
+                                        declared=True,
+                                    )
+                                )
+
+    # transitive acquisition sets: id(FuncInfo) -> {lock: (first site,
+    # human chain from that function's entry to the acquisition)}.
+    # Computed as a WORKLIST FIXPOINT, not a DFS memo: a DFS that returns
+    # a partial set for an on-stack cycle member and memoizes it would
+    # permanently miss acquisitions reachable through recursion, and which
+    # inversions got missed would depend on traversal order.
+    def _acq_fixpoint(self) -> Dict[int, Dict[Lock, Tuple[str, Tuple[str, ...]]]]:
+        acq: Dict[int, Dict[Lock, Tuple[str, Tuple[str, ...]]]] = {}
+        for fi in self._functions:
+            walker = self.project.walker(fi)
+            acq[id(fi)] = {
+                lock: (f"{walker.mi.path}:{line}", chain)
+                for lock, (line, chain) in walker.local_acq.items()
+            }
+        changed = True
+        while changed:
+            changed = False
+            for fi in self._functions:
+                walker = self.project.walker(fi)
+                out = acq[id(fi)]
+                for callee, line, held in walker.calls:
+                    for lock, (site, chain) in acq.get(id(callee), {}).items():
+                        if lock in held:
+                            continue  # re-entrant through the call
+                        if lock not in out:
+                            step = (
+                                f"{walker.mi.path}:{line} -> "
+                                f"{callee.qualname()}()",
+                            )
+                            out[lock] = (site, step + chain)
+                            changed = True
+        return acq
+
+    def _propagate(self, fi: FuncInfo) -> None:
+        walker = self.project.walker(fi)
+        for callee, line, held in walker.calls:
+            if not held:
+                continue
+            sub = self._acq.get(id(callee), {})
+            for lock, (site, chain) in sub.items():
+                if lock in held:
+                    if not self.project.is_rlock(lock):
+                        self.edges.append(
+                            Edge(
+                                lock,
+                                lock,
+                                walker.mi.path,
+                                line,
+                                (
+                                    f"{walker.mi.path}:{line} -> "
+                                    f"{callee.qualname()}()",
+                                )
+                                + chain,
+                            )
+                        )
+                    continue
+                step = (f"{walker.mi.path}:{line} -> {callee.qualname()}()",)
+                for h in held:
+                    self.edges.append(
+                        Edge(h, lock, walker.mi.path, line, step + chain)
+                    )
+
+    def cycles(self) -> List[List[Edge]]:
+        """Elementary cycles, one representative per strongly-connected
+        knot, deterministic order.  Self-edges (non-re-entrant
+        re-acquisition) are length-1 cycles."""
+        #: (A, B) -> representative edge (prefer real over declared,
+        #: then lowest path/line)
+        best: Dict[Tuple[Lock, Lock], Edge] = {}
+        for e in self.edges:
+            key = (e.held, e.acquired)
+            cur = best.get(key)
+            if (
+                cur is None
+                or (cur.declared and not e.declared)
+                or (
+                    cur.declared == e.declared
+                    and (e.path, e.line) < (cur.path, cur.line)
+                )
+            ):
+                best[key] = e
+        adj: Dict[Lock, List[Lock]] = {}
+        for (a, b) in best:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for outs in adj.values():
+            outs.sort(key=lambda lk: lk.display())
+
+        out: List[List[Edge]] = []
+        # self-loops first
+        for (a, b), e in sorted(
+            best.items(), key=lambda kv: (kv[1].path, kv[1].line)
+        ):
+            if a == b:
+                out.append([e])
+        # one shortest cycle per SCC (size >= 2), found by BFS back-edge
+        sccs = _tarjan(adj)
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            start = min(scc, key=lambda lk: lk.display())
+            cycle = _shortest_cycle(adj, best, start, set(scc))
+            if cycle:
+                out.append(cycle)
+        return out
+
+
+def _tarjan(adj: Dict[Lock, List[Lock]]) -> List[List[Lock]]:
+    index: Dict[Lock, int] = {}
+    low: Dict[Lock, int] = {}
+    on_stack: Set[Lock] = set()
+    stack: List[Lock] = []
+    sccs: List[List[Lock]] = []
+    counter = [0]
+
+    def strongconnect(v: Lock) -> None:
+        # iterative Tarjan: the graph is tiny but recursion depth is not
+        # worth betting on
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(adj, key=lambda lk: lk.display()):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _shortest_cycle(
+    adj: Dict[Lock, List[Lock]],
+    best: Dict[Tuple[Lock, Lock], Edge],
+    start: Lock,
+    members: Set[Lock],
+) -> List[Edge]:
+    """BFS from ``start`` back to itself inside one SCC; returns the edge
+    list of the cycle."""
+    prev: Dict[Lock, Lock] = {}
+    frontier = [start]
+    seen = {start}
+    found = False
+    while frontier and not found:
+        nxt = []
+        for node in frontier:
+            for w in adj.get(node, ()):
+                if w not in members:
+                    continue
+                if w == start:
+                    prev[start] = node
+                    found = True
+                    break
+                if w not in seen:
+                    seen.add(w)
+                    prev[w] = node
+                    nxt.append(w)
+            if found:
+                break
+        frontier = nxt
+    if not found:
+        return []
+    # rebuild start -> ... -> start
+    nodes = [start]
+    node = prev[start]
+    while node != start:
+        nodes.append(node)
+        node = prev[node]
+    nodes.append(start)
+    nodes.reverse()  # start, ..., start in forward order
+    return [best[(a, b)] for a, b in zip(nodes, nodes[1:])]

@@ -1,0 +1,469 @@
+"""Passes #6-#8 — the interprocedural concurrency layer over callgraph.py.
+
+The last two review cycles caught exactly the bug shapes these passes own
+(the PR 7 tenant-cap check-then-act steal, the PR 10 admission
+double-book), and pass #3 could see none of them: a helper called under a
+lock, an acquisition order spanning two functions, a check and its act in
+two different critical sections.  Three passes, one shared engine:
+
+* #6 ``holds-lock`` — ``NOHOLD``: a call to a ``# holds-lock: <lock>``
+  function at a site where the lock is not held (entry contract +
+  enclosing ``with``s, alias-unified, re-entrant-safe).  ``HELDLOCK``: a
+  ``# guarded-by:`` access inside a holds-lock function whose guard is
+  neither declared held nor locally taken — pass #3 DELEGATES annotated
+  functions here, so the two layers read one grammar and cannot disagree.
+* #7 ``lock-order`` — ``LOCKORDER``: cycles in the project-wide
+  acquisition graph (edge A->B when B is acquired while A is held,
+  propagated through the call graph), reported with the full
+  ``file:line`` acquisition chains.  ``# lock-order: A < B`` module
+  declarations pin the sanctioned order as virtual edges, so one real
+  inversion closes a cycle even before the reverse path is written;
+  re-entrant RLock self-edges (the server's ``_admission``) are exempt.
+* #8 ``check-then-act`` — ``TOCTOU``: a read of ``# guarded-by:`` state
+  in one lock region feeding a conditional that guards a write to the
+  same state in a DIFFERENT (or absent) region of the same function.
+  A re-check of the same state under the write's own acquisition (the
+  double-checked-locking shape) sanctions the write.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gelly_streaming_tpu import analysis
+from gelly_streaming_tpu.analysis import callgraph
+
+_SINGLE_RE = re.compile(r"#\s*single-thread:")
+
+
+def _dedup(findings: List[analysis.Finding]) -> List[analysis.Finding]:
+    seen: Set[Tuple[str, int, str, str]] = set()
+    out = []
+    for f in findings:
+        key = (f.path, f.line, f.code, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+class HoldsLockPass(analysis.ProjectPass):
+    name = "holds-lock"
+    codes = ("NOHOLD", "HELDLOCK")
+    description = (
+        "# holds-lock: functions called with the lock held; their "
+        "guarded accesses checked against the declared held set"
+    )
+
+    def run_project(self, project: callgraph.Project) -> List[analysis.Finding]:
+        findings: List[analysis.Finding] = []
+        for fi in project.all_functions():
+            walker = project.walker(fi)
+            sf = fi.module.sf
+            if not fi.single_thread:
+                findings.extend(self._check_calls(project, fi, walker, sf))
+            if fi.holds_raw and not fi.single_thread:
+                findings.extend(self._check_accesses(project, fi, walker, sf))
+        return _dedup(findings)
+
+    def _check_calls(self, project, fi, walker, sf) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        for callee, line, held in walker.calls:
+            required = project.entry_holds(callee)
+            if not required:
+                continue
+            if callee.single_thread:
+                continue  # the callee claimed exclusivity with a reason
+            for lock in required:
+                if lock in held:
+                    continue
+                if _SINGLE_RE.search(sf.comment(line)):
+                    continue  # per-line exclusivity claim, pass-3 grammar
+                out.append(
+                    sf.finding(
+                        line,
+                        self.name,
+                        "NOHOLD",
+                        f"call to {callee.qualname()}() ('# holds-lock: "
+                        f"{lock.display()}') without {lock.display()} held "
+                        "(take the lock around the call, or drop the "
+                        "callee's holds-lock contract)",
+                    )
+                )
+        return out
+
+    def _check_accesses(self, project, fi, walker, sf) -> List[analysis.Finding]:
+        mi = fi.module
+        out: List[analysis.Finding] = []
+        for kind, name, line, held in walker.accesses:
+            if line in mi.guard_decl_lines:
+                continue
+            if _SINGLE_RE.search(sf.comment(line)):
+                continue
+            if kind == "attr":
+                guard = mi.attr_guards[(fi.cls, name)]
+                glock = project.canonical(
+                    callgraph.Lock(mi.name, fi.cls, guard)
+                )
+                label = f"self.{name}"
+            else:
+                guard = mi.global_guards[name]
+                glock = project.canonical(callgraph.Lock(mi.name, None, guard))
+                label = name
+            if glock not in held:
+                out.append(
+                    sf.finding(
+                        line,
+                        self.name,
+                        "HELDLOCK",
+                        f"{label} is '# guarded-by: {guard}' but the "
+                        f"enclosing '# holds-lock:' function neither "
+                        f"declares nor takes {glock.display()} (add it to "
+                        "the holds-lock contract, or take the lock here)",
+                    )
+                )
+        return out
+
+
+class LockOrderPass(analysis.ProjectPass):
+    name = "lock-order"
+    codes = ("LOCKORDER",)
+    description = (
+        "cycle-free global lock-acquisition order (interprocedural; "
+        "# lock-order: declares the sanctioned order)"
+    )
+
+    def run_project(self, project: callgraph.Project) -> List[analysis.Finding]:
+        graph = callgraph.AcquisitionGraph(project)
+        findings: List[analysis.Finding] = []
+        for cycle in graph.cycles():
+            anchor = next((e for e in cycle if not e.declared), cycle[0])
+            chain = " -> ".join(
+                [e.held.display() for e in cycle] + [cycle[0].held.display()]
+            )
+            if len(cycle) == 1 and cycle[0].held == cycle[0].acquired:
+                chain = (
+                    f"{cycle[0].held.display()} re-acquired while held "
+                    "(not an RLock)"
+                )
+            detail = "; ".join(
+                "[{}]".format(" ".join(e.via)) for e in cycle
+            )
+            findings.append(
+                analysis.Finding(
+                    anchor.path,
+                    anchor.line,
+                    self.name,
+                    "LOCKORDER",
+                    f"lock-order cycle: {chain} — acquisition paths: "
+                    f"{detail}.  Pick ONE order, declare it with "
+                    "'# lock-order: A < B', and re-order the acquisitions",
+                )
+            )
+        findings.sort(key=lambda f: (f.path, f.line, f.message))
+        return _dedup(findings)
+
+
+# ---------------------------------------------------------------------------
+# Pass #8: check-then-act
+
+
+#: container-mutating method names that count as writes to the registry
+_MUTATORS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "add",
+        "clear",
+        "discard",
+        "extend",
+        "insert",
+        "pop",
+        "popitem",
+        "popleft",
+        "remove",
+        "setdefault",
+        "update",
+    }
+)
+
+#: pseudo-region id for locks guaranteed held across the whole function
+#: (a ``# holds-lock:`` declaration makes the function ONE critical
+#: section); regions are (with-node id, lock) pairs otherwise
+_ENTRY = -1
+
+
+class CheckThenActPass(analysis.ProjectPass):
+    name = "check-then-act"
+    codes = ("TOCTOU",)
+    description = (
+        "a guarded read feeding a conditional must share its lock region "
+        "with the write it guards (split check/act = lost-update race)"
+    )
+
+    def run_project(self, project: callgraph.Project) -> List[analysis.Finding]:
+        findings: List[analysis.Finding] = []
+        for fi in project.all_functions():
+            if fi.single_thread:
+                continue
+            findings.extend(_FunctionTOCTOU(project, fi, self.name).run())
+        findings.sort(key=lambda f: (f.path, f.line, f.message))
+        return _dedup(findings)
+
+
+class _FunctionTOCTOU:
+    """One function's check-then-act walk.
+
+    Regions are (With-node id, lock) pairs; ``# holds-lock:`` entry locks
+    form a whole-function pseudo-region, so an annotated helper is one
+    critical section by contract.  A guarded read in the test of an
+    ``if``/``while`` (directly or through a single-assignment tainted
+    local) arms every write to the SAME attribute inside that branch: the
+    write must share a region whose lock IS the attribute's guard with at
+    least one read of the attribute among its guarding tests, else it
+    races a concurrent mutator between check and act.
+    """
+
+    def __init__(self, project, fi, pass_name: str):
+        self.project = project
+        self.fi = fi
+        self.mi = fi.module
+        self.sf = fi.module.sf
+        self.pass_name = pass_name
+        #: local name -> list of (attr_key, regions, line) it was read from
+        self.taint: Dict[str, List[Tuple[Tuple[str, str], Tuple, int]]] = {}
+        self.findings: List[analysis.Finding] = []
+        self.entry_regions = tuple(
+            (_ENTRY, lock) for lock in project.entry_holds(fi)
+        )
+
+    def run(self) -> List[analysis.Finding]:
+        if not self.mi.attr_guards and not self.mi.global_guards:
+            return []
+        self._walk(self.fi.node.body, self.entry_regions, ())
+        return self.findings
+
+    # -- guards ------------------------------------------------------------
+
+    def _guard_of(self, key: Tuple[str, str]) -> Optional[callgraph.Lock]:
+        kind, name = key
+        if kind == "attr":
+            guard = self.mi.attr_guards.get((self.fi.cls, name))
+            if guard is None:
+                return None
+            return self.project.canonical(
+                callgraph.Lock(self.mi.name, self.fi.cls, guard)
+            )
+        guard = self.mi.global_guards.get(name)
+        if guard is None:
+            return None
+        return self.project.canonical(
+            callgraph.Lock(self.mi.name, None, guard)
+        )
+
+    def _direct_reads(
+        self, expr: ast.AST, regions: Tuple
+    ) -> List[Tuple[Tuple[str, str], Tuple, int]]:
+        """Guarded reads inside one expression (lambda bodies excluded)."""
+        out: List[Tuple[Tuple[str, str], Tuple, int]] = []
+        stack: List[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and self.fi.cls is not None
+                and (self.fi.cls, node.attr) in self.mi.attr_guards
+            ):
+                out.append((("attr", node.attr), regions, node.lineno))
+            elif (
+                isinstance(node, ast.Name)
+                and node.id in self.mi.global_guards
+                and isinstance(node.ctx, ast.Load)
+            ):
+                out.append((("global", node.id), regions, node.lineno))
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                out.extend(self.taint.get(node.id, []))
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    # -- writes ------------------------------------------------------------
+
+    def _writes_in_stmt(self, stmt: ast.AST) -> List[Tuple[Tuple[str, str], int]]:
+        out: List[Tuple[Tuple[str, str], int]] = []
+
+        def key_of(expr: ast.AST) -> Optional[Tuple[Tuple[str, str], int]]:
+            # self.X / self.X[...] / X / X[...]
+            base = expr
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and self.fi.cls is not None
+                and (self.fi.cls, base.attr) in self.mi.attr_guards
+            ):
+                return (("attr", base.attr), base.lineno)
+            if (
+                isinstance(base, ast.Name)
+                and base.id in self.mi.global_guards
+            ):
+                return (("global", base.id), base.lineno)
+            return None
+
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                k = key_of(t)
+                if k:
+                    out.append(k)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            k = key_of(stmt.target)
+            if k:
+                out.append(k)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                k = key_of(t)
+                if k:
+                    out.append(k)
+        # mutator method calls ANYWHERE in the statement's own expressions
+        # (`self._d.pop(k)` as a bare statement, assigned, returned, or
+        # inside a condition — the act is the same act); nested statement
+        # blocks are NOT descended into, their writes are found when the
+        # walk visits them at their own region
+        for expr in self._expr_roots(stmt):
+            stack: List[ast.AST] = [expr]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr in _MUTATORS:
+                    k = key_of(node.func.value)
+                    if k:
+                        out.append(k)
+                stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    @staticmethod
+    def _expr_roots(stmt: ast.AST) -> List[ast.expr]:
+        roots: List[ast.expr] = []
+        for name in ("value", "test", "iter", "exc", "msg", "target"):
+            sub = getattr(stmt, name, None)
+            if isinstance(sub, ast.expr):
+                roots.append(sub)
+        for t in getattr(stmt, "targets", []) or []:
+            if isinstance(t, ast.expr):
+                roots.append(t)
+        return roots
+
+    # -- the walk ----------------------------------------------------------
+
+    def _walk(self, body: Sequence[ast.stmt], regions: Tuple, armed: Tuple) -> None:
+        """``armed``: tuple of (attr_key, read_regions, read_line) from the
+        tests of enclosing conditionals."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate function, separate analysis
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = regions
+                for item in stmt.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, (ast.Name, ast.Attribute)):
+                        lock = self.project.lock_from_expr(
+                            self.mi, self.fi.cls, ctx
+                        )
+                        if lock is not None:
+                            lock = self.project.canonical(lock)
+                        inner = inner + ((id(stmt), lock),)
+                self._walk(stmt.body, inner, armed)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                # a mutator call in the TEST itself is an act too
+                # (`if self._d.pop(k):`), guarded by the ENCLOSING arms
+                if armed:
+                    for key, line in self._writes_in_stmt(stmt):
+                        self._check_write(key, line, regions, armed)
+                reads = self._direct_reads(stmt.test, regions)
+                inner_armed = armed + tuple(reads)
+                self._walk(stmt.body, regions, inner_armed)
+                # the else branch acts on the SAME decision
+                self._walk(stmt.orelse, regions, inner_armed)
+                continue
+            # writes under the armed conditionals
+            if armed:
+                for key, line in self._writes_in_stmt(stmt):
+                    self._check_write(key, line, regions, armed)
+            # taint bookkeeping: single-name assignment from guarded reads
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+                isinstance(stmt.targets[0], ast.Name)
+            ):
+                name = stmt.targets[0].id
+                reads = self._direct_reads(stmt.value, regions)
+                if reads:
+                    self.taint[name] = reads
+                else:
+                    self.taint.pop(name, None)
+            # recurse into remaining block-bearing statements (try/for/...)
+            for attr in ("body", "orelse", "finalbody"):
+                block = getattr(stmt, attr, None)
+                if isinstance(block, list):
+                    self._walk(block, regions, armed)
+            for handler in getattr(stmt, "handlers", []) or []:
+                if isinstance(handler, ast.ExceptHandler):
+                    self._walk(handler.body, regions, armed)
+
+    def _check_write(
+        self, key: Tuple[str, str], line: int, regions: Tuple, armed: Tuple
+    ) -> None:
+        guard = self._guard_of(key)
+        if guard is None:
+            return
+        relevant = [a for a in armed if a[0] == key]
+        if not relevant:
+            return
+        write_guard_regions = {
+            r for r in regions if r[1] == guard
+        }
+        for _key, read_regions, _read_line in relevant:
+            if tuple(read_regions) == tuple(regions):
+                # identical critical sections (or identically absent):
+                # there is no SPLIT — a missing guard here is pass #3's
+                # UNGUARDED, not a check-then-act
+                return
+            if set(read_regions) & write_guard_regions:
+                return  # checked and acted under ONE guard acquisition
+        # no guarding test shares the write's critical section: report
+        # against the innermost (latest) read
+        _key, _read_regions, read_line = relevant[-1]
+        kind, name = key
+        label = f"self.{name}" if kind == "attr" else name
+        lockname = (
+            self.mi.attr_guards.get((self.fi.cls, name))
+            if kind == "attr"
+            else self.mi.global_guards.get(name)
+        )
+        self.findings.append(
+            self.sf.finding(
+                line,
+                self.pass_name,
+                "TOCTOU",
+                f"{label} is written here based on a check of {label} made "
+                f"in a different '{lockname}' region (read at line "
+                f"{read_line}): a concurrent mutator can act between the "
+                "check and this write — do both under ONE "
+                f"'with ...{lockname}:' block, or re-check under the "
+                "write's acquisition",
+            )
+        )
+
+
+analysis.register(HoldsLockPass())
+analysis.register(LockOrderPass())
+analysis.register(CheckThenActPass())

@@ -20,42 +20,29 @@ Annotation grammar:
 
 Scope and limits (deliberate): instance attributes are checked inside their
 defining class only (``self.X``); aliasing through other names is not
-tracked, and a lock held by a CALLER does not exempt a callee — factor the
-locked section so the ``with`` is visible where the access is, which is
-also what makes the code reviewable.  Module top-level statements run on
-the importing thread and are exempt.
+tracked.  A lock held by a CALLER exempts a callee only when the callee
+DECLARES the contract with ``# holds-lock: <lock>`` — such functions are
+delegated wholesale to pass #6 (``HELDLOCK``), which checks their guarded
+accesses against the declared held set and their call sites for the lock;
+both passes read the one annotation grammar in ``callgraph.py``, so the
+intra- and interprocedural layers cannot disagree.  Module top-level
+statements run on the importing thread and are exempt.
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Optional, Set, Tuple
 
 from gelly_streaming_tpu import analysis
+from gelly_streaming_tpu.analysis.callgraph import (
+    collect_guards,
+    holds_decl_names,
+    single_thread_marked as _single_thread_marked,
+)
 
-_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 _SINGLE_RE = re.compile(r"#\s*single-thread:")
-
-
-def _guard_on_lines(sf: analysis.SourceFile, start: int, end: int) -> Optional[str]:
-    for i in range(start, end + 1):
-        m = _GUARDED_RE.search(sf.comment(i))
-        if m:
-            return m.group(1)
-    return None
-
-
-def _single_thread_marked(sf: analysis.SourceFile, node: ast.AST) -> bool:
-    """``# single-thread:`` on the def line, its decorators, or the line
-    directly above the construct."""
-    first = min(
-        [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
-    )
-    for i in range(first - 1, node.body[0].lineno):
-        if _SINGLE_RE.search(sf.comment(i)):
-            return True
-    return False
 
 
 class LockDisciplinePass(analysis.Pass):
@@ -64,43 +51,8 @@ class LockDisciplinePass(analysis.Pass):
     description = "# guarded-by: state accessed only under its lock"
 
     def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
-        # ---- collect annotated declarations -----------------------------
-        #: (class name, attr) -> lock attr name (lock reached via self)
-        attr_guards: Dict[Tuple[str, str], str] = {}
-        #: global name -> lock global name
-        global_guards: Dict[str, str] = {}
-        #: lines of the declarations themselves (exempt from checking)
-        decl_lines: Set[int] = set()
-
-        def collect(node: ast.AST, cls: Optional[str]) -> None:
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, ast.ClassDef):
-                    collect(child, child.name)
-                    continue
-                if isinstance(child, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
-                    end = getattr(child, "end_lineno", None) or child.lineno
-                    lock = _guard_on_lines(sf, child.lineno, end)
-                    if lock is not None:
-                        targets = (
-                            child.targets
-                            if isinstance(child, ast.Assign)
-                            else [child.target]
-                        )
-                        for t in targets:
-                            if (
-                                isinstance(t, ast.Attribute)
-                                and isinstance(t.value, ast.Name)
-                                and t.value.id == "self"
-                                and cls is not None
-                            ):
-                                attr_guards[(cls, t.attr)] = lock
-                                decl_lines.update(range(child.lineno, end + 1))
-                            elif isinstance(t, ast.Name) and cls is None:
-                                global_guards[t.id] = lock
-                                decl_lines.update(range(child.lineno, end + 1))
-                collect(child, cls)
-
-        collect(sf.tree, None)
+        # annotated declarations, via the shared engine (callgraph.py)
+        attr_guards, global_guards, decl_lines = collect_guards(sf)
         if not attr_guards and not global_guards:
             return []
 
@@ -123,13 +75,20 @@ class LockDisciplinePass(analysis.Pass):
                 if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     # a nested function may run on any thread at any time:
                     # it inherits neither the enclosing with-blocks nor, for
-                    # safety, an enclosing function's single-thread marking
+                    # safety, an enclosing function's single-thread marking.
+                    # A '# holds-lock:' function is DELEGATED: pass #6 owns
+                    # its guarded accesses (checked against the declared
+                    # held set) and its call sites (NOHOLD) — treating it
+                    # as exempt-with-a-contract here is what lets a helper
+                    # mutate under its caller's lock without a false
+                    # UNGUARDED, while the contract stays checkable.
                     check(
                         child,
                         cls,
                         func_depth + 1,
                         set(),
-                        _single_thread_marked(sf, child),
+                        _single_thread_marked(sf, child)
+                        or bool(holds_decl_names(sf, child)),
                     )
                     continue
                 if isinstance(child, (ast.With, ast.AsyncWith)):

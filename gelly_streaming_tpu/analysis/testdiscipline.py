@@ -1,0 +1,111 @@
+"""Pass #9 — ``test-discipline``: concurrency-driving tests carry a cap.
+
+A test that spawns threads, opens sockets, or forks subprocesses can hang
+instead of fail — and a hung test wedges the whole tier-1 run at the CI
+timeout instead of failing at the test that broke.  The repo's contract is
+``@pytest.mark.timeout_cap(seconds)`` (tests/conftest.py): this pass makes
+the contract checkable, so a new serving-plane test cannot quietly ship
+without one.
+
+Detection is deliberately name-based: the test's body (nested defs
+included) references the ``threading`` / ``socket`` / ``subprocess`` /
+``multiprocessing`` modules, or the directly-imported ``Thread`` /
+``Popen`` / ``Process`` constructors.  Tests that drive threads only
+through fixtures/helpers are out of scope by design — the helper's own
+module is where the discipline lives.  Satisfied by a ``timeout_cap``
+decorator on the test or a module-level ``pytestmark``.  Inert on the
+package tree (no ``test_*`` functions); the tier-1 gate runs it over
+``tests/``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from gelly_streaming_tpu import analysis
+
+_MODULES = frozenset({"threading", "socket", "subprocess", "multiprocessing"})
+_CTORS = frozenset({"Thread", "Popen", "Process"})
+
+
+def _has_timeout_cap(node: ast.AST) -> bool:
+    for d in getattr(node, "decorator_list", []):
+        try:
+            if "timeout_cap" in ast.unparse(d):
+                return True
+        except Exception:  # pragma: no cover — exotic decorator
+            continue
+    return False
+
+
+def _module_pytestmark_caps(tree: ast.AST) -> bool:
+    for child in ast.iter_child_nodes(tree):
+        if isinstance(child, ast.Assign):
+            for t in child.targets:
+                if isinstance(t, ast.Name) and t.id == "pytestmark":
+                    try:
+                        if "timeout_cap" in ast.unparse(child.value):
+                            return True
+                    except Exception:  # pragma: no cover
+                        continue
+    return False
+
+
+def _drives_concurrency(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in (_MODULES | _CTORS):
+            return True
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in _MODULES
+        ):
+            return True
+    return False
+
+
+class TestDisciplinePass(analysis.Pass):
+    name = "test-discipline"
+    codes = ("NOTIMEOUT",)
+    description = (
+        "test_* driving threads/sockets/subprocesses must carry "
+        "@pytest.mark.timeout_cap"
+    )
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        if _module_pytestmark_caps(sf.tree):
+            return out
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child)
+                    continue
+                if not isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if not child.name.startswith("test_"):
+                    continue
+                if _has_timeout_cap(child):
+                    continue
+                if _drives_concurrency(child):
+                    out.append(
+                        sf.finding(
+                            child.lineno,
+                            self.name,
+                            "NOTIMEOUT",
+                            f"{child.name} drives threads/sockets/"
+                            "subprocesses without "
+                            "@pytest.mark.timeout_cap(seconds) — a hang "
+                            "must fail the test, not wedge the suite",
+                        )
+                    )
+
+        scan(sf.tree)
+        return out
+
+
+analysis.register(TestDisciplinePass())

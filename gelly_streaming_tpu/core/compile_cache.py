@@ -49,6 +49,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+# A LEAF of the runtime's lock order: builds and jit-traces run OUTSIDE
+# the lock by design (they may import/trace arbitrarily), so nothing
+# here may take a runtime lock; the scheduler, holding the manager lock,
+# may reach the cache counters but never the reverse.
+# lock-order: manager._lock < compile_cache._LOCK
 _LOCK = threading.RLock()
 # Shared across every dispatch thread (sync loops, the async pipeline's
 # dispatch + drain, the mesh runners): the ``# guarded-by: _LOCK``

@@ -284,6 +284,12 @@ class NetworkEdgeSource:
         # the job's stream factory; the put side blocks (that is the
         # backpressure), the get side is guarded by ready()
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, max_queued_batches))
+        # a deep LEAF of the runtime's lock order: push/scheduler/server
+        # threads all take it bare, and the wake callback (on_data ->
+        # JobManager.poke) runs with it RELEASED, so nothing here may
+        # re-enter a runtime lock; the queue's own mutex is only ever
+        # taken in SEQUENCE with it (progress()), never nested.
+        # lock-order: server.StreamServer._admission < sources.NetworkEdgeSource._lock
         self._lock = threading.Lock()
         # edges accepted (resume filler counts as pre-accepted)
         self._edges_in = resume_edges  # guarded-by: _lock

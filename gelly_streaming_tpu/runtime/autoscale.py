@@ -63,6 +63,14 @@ from gelly_streaming_tpu.utils.envswitch import resolve_switch
 #: importing the job module into the policy layer)
 _TERMINAL = frozenset({"DONE", "FAILED", "CANCELLED"})
 
+# The registry lock sits BELOW the serving plane's admission lock:
+# registration happens on connection threads (which may later hold
+# _admission around it), while actuation — which takes _admission through
+# the rescale handle — runs with the registry lock RELEASED.  Holding
+# _lock across handle.rescale() would close this declared cycle, and pass
+# #7 reports it before it deadlocks a live re-shard.
+# lock-order: server.StreamServer._admission < autoscale.Autoscaler._lock
+
 
 def resolve_autoscale(cfg) -> bool:
     """Effective autoscale switch: config > env > OFF.

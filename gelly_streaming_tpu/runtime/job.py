@@ -153,7 +153,10 @@ class Job:
         # NetworkEdgeSource.progress).  Sampled by the scheduler loop at
         # the health rate; None = gauge row limited to sink-side figures.
         self._progress = progress
-        self._lock = manager_lock  # the MANAGER's lock, shared by reference
+        # the MANAGER's RLock, shared by reference: the analyzer unifies
+        # the two identities so edges through either are re-entrant on
+        # the other
+        self._lock = manager_lock  # lock-alias: manager._lock
         self._state = JobState.PENDING  # guarded-by: _lock
         self._error: Optional[BaseException] = None  # guarded-by: _lock
         self._cancel_requested = False  # guarded-by: _lock
@@ -249,9 +252,12 @@ class Job:
 
     # -- transitions (manager/scheduler only) --------------------------------
 
+    # holds-lock: _lock
     def _transition(self, new_state: str) -> None:
-        """Move the state machine; caller MUST hold the manager lock (the
-        re-entrant acquisition here is the analyzer-visible guard).
+        """Move the state machine; caller MUST hold the manager lock — the
+        ``# holds-lock:`` contract makes every call site checkable (pass
+        #6), and the re-entrant acquisition below keeps the guard visible
+        locally too.
 
         Every legal transition lands in the structured event journal
         (utils/events.py) — the journal lock is a leaf lock, so emitting

@@ -38,6 +38,18 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional
 
+# The sanctioned global lock order (pinned by graftcheck pass #7): the
+# manager lock is the runtime's root, and the journal/metrics registries
+# are LEAF locks — emitting or counting under the manager lock is the
+# documented-safe direction, and nothing called under a leaf lock may
+# re-enter the manager.
+# lock-order: manager._lock < events._JOURNAL_LOCK < events.EventJournal._lock
+# lock-order: manager._lock < metrics._JOB_LOCK
+# lock-order: manager._lock < metrics._HEALTH_LOCK
+# lock-order: manager._lock < metrics._ALERT_LOCK
+# lock-order: manager._lock < metrics._SCALE_LOCK
+# lock-order: manager._lock < metrics._HIST_LOCK
+
 from gelly_streaming_tpu.core.config import RuntimeConfig
 from gelly_streaming_tpu.runtime.job import (
     _SENTINEL,
@@ -372,6 +384,7 @@ class JobManager:
         with self._lock:
             return self._autoscaler
 
+    # holds-lock: _lock
     def _evict_old_terminal(self) -> None:
         """Bound the terminal-job history to ``keep_terminal_jobs`` (oldest
         first; dict order is submission order).  Caller holds _lock.  The
@@ -548,6 +561,7 @@ class JobManager:
 
     # -- scheduler internals -------------------------------------------------
 
+    # holds-lock: _lock
     def _ensure_scheduler(self) -> None:
         """Start the scheduler thread on first submit; caller holds _lock.
         The SLO monitor (when objectives are configured) starts and stops
@@ -611,6 +625,7 @@ class JobManager:
                 job._transition(JobState.DONE)
                 self._release(job)
 
+    # holds-lock: _lock
     def _release(self, job: Job) -> None:
         """Return a terminal job's admitted bytes and drop its source
         closure (which may capture the whole input dataset) so a retained

@@ -50,6 +50,15 @@ from gelly_streaming_tpu.core.config import (
     StreamConfig,
     TenantConfig,
 )
+
+# The serving plane's slice of the sanctioned global lock order (pass
+# #7): the re-entrant admission serialization is the OUTERMOST lock of
+# the whole runtime — it wraps the connection registry and the manager's
+# admission RLock (check -> submit -> register is one atomic step), so
+# nothing called under the manager or a leaf registry lock may take it.
+# lock-order: server.StreamServer._admission < server.StreamServer._lock
+# lock-order: server.StreamServer._admission < manager._lock
+# lock-order: server.StreamServer._admission < metrics._TENANT_LOCK
 from gelly_streaming_tpu.runtime import protocol
 from gelly_streaming_tpu.runtime.job import AdmissionError, Job, JobState
 from gelly_streaming_tpu.runtime.manager import JobManager
@@ -809,6 +818,7 @@ class StreamServer:
             False,
         )
 
+    # holds-lock: _admission
     def _admit_tenant(self, tenant: TenantConfig, new_state_bytes: int) -> None:
         """Per-tenant admission on top of the manager's global caps; caller
         holds ``_admission`` and gets a typed refusal, the counters get the

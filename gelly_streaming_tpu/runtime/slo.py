@@ -37,6 +37,12 @@ from __future__ import annotations
 
 import fnmatch
 import threading
+
+# The monitor emits alert transitions to the journal AFTER the registry
+# write, never while holding the alert lock — but the sanctioned nesting
+# direction (registry above journal, both leaves of the runtime spine) is
+# declared so a future emit-under-lock cannot invert it silently.
+# lock-order: metrics._ALERT_LOCK < events._JOURNAL_LOCK
 import time
 from collections import deque
 from typing import List, Optional, Tuple

@@ -214,6 +214,11 @@ class EventJournal:
 # the process-global journal (same pattern as tracing.flight_recorder)
 
 
+# The journal locks are the runtime's DEEPEST leaves: emitting under the
+# manager lock is the documented-safe order (job transitions journal in
+# the same hold that makes them), and nothing called under either lock
+# below may re-enter a runtime lock.  Pass #7 pins the direction.
+# lock-order: events._JOURNAL_LOCK < events.EventJournal._lock
 _JOURNAL_LOCK = threading.Lock()
 _JOURNAL: Optional[EventJournal] = None  # guarded-by: _JOURNAL_LOCK
 

@@ -357,6 +357,10 @@ def reset_wire_stats() -> None:
 # module figure).
 
 
+# The per-registry locks are SIBLING leaves (drop_job_stats takes the
+# job then the histogram registry in sequence, never nested); if a
+# future edit needs both at once, this is the sanctioned direction.
+# lock-order: metrics._JOB_LOCK < metrics._HIST_LOCK
 _JOB_LOCK = threading.Lock()
 
 

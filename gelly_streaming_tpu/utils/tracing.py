@@ -367,6 +367,10 @@ class FlightRecorder:
             self._stage_totals = {}
 
 
+# The recorder lock nests inside the manager lock and never the other
+# way around (JobManager._fail snapshots the ring for a FAILED job's
+# post-mortem) — declared so the inverse acquisition can never ship.
+# lock-order: manager._lock < tracing._RECORDER_LOCK
 _RECORDER_LOCK = threading.Lock()
 _RECORDER: Optional[FlightRecorder] = None  # guarded-by: _RECORDER_LOCK
 

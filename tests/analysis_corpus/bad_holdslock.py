@@ -1,0 +1,45 @@
+"""Seeded bugs: the interprocedural lock contracts pass #3 cannot see.
+
+The helper-mutates-under-caller's-lock shape (runtime/manager.py's
+``_release`` / ``_evict_old_terminal`` discipline): ``_evict`` declares
+``# holds-lock: _lock`` and mutates the guarded registry relying on its
+caller's acquisition — invisible to the intraprocedural pass #3, which
+delegates annotated functions to pass #6.
+
+Expected findings: exactly one NOHOLD (the unlocked call to ``_evict`` in
+``tick``) and one HELDLOCK (``report`` declares ``_lock`` but touches
+state guarded by ``_mu`` without taking it).  Analyzer input only — never
+imported.
+"""
+
+import threading
+
+
+class BadRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mu = threading.Lock()
+        self._jobs = {}  # guarded-by: _lock
+        self._stats = {}  # guarded-by: _mu
+
+    # holds-lock: _lock
+    def _evict(self, key):
+        # fine BY CONTRACT: the caller holds _lock (pass #6 checks the
+        # call sites; pass #3 delegates this function)
+        self._jobs.pop(key, None)
+
+    def shutdown(self, key):
+        with self._lock:
+            self._evict(key)  # ok: lock held across the call
+
+    def tick(self, key):
+        # BUG: the helper's contract says _lock must be held here — a
+        # concurrent shutdown() can evict between our check and the
+        # helper's mutation
+        self._evict(key)
+
+    # holds-lock: _lock
+    def report(self):
+        # BUG: _stats is guarded by _mu, which this function neither
+        # declares nor takes — the caller's _lock does not protect it
+        return len(self._stats)

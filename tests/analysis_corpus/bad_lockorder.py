@@ -1,0 +1,34 @@
+"""Seeded bug: a lock-order inversion SPANNING TWO FUNCTIONS — the
+interprocedural case pass #3 (and any per-function scan) provably misses:
+no single function acquires both locks, yet ``drain`` (A then, via
+``_flush``, B) racing ``rebalance`` (B then, via ``_recount``, A)
+deadlocks with each thread holding the other's next lock.
+
+Expected findings: exactly one LOCKORDER naming the A->B->A cycle with
+both acquisition chains.  Analyzer input only — never imported.
+"""
+
+import threading
+
+_ADMIT = threading.Lock()
+_STATE = threading.Lock()
+
+
+def drain():
+    with _ADMIT:
+        _flush()
+
+
+def _flush():
+    with _STATE:
+        pass
+
+
+def rebalance():
+    with _STATE:
+        _recount()
+
+
+def _recount():
+    with _ADMIT:
+        pass

@@ -1,0 +1,37 @@
+"""Seeded bug: the split-lock check-then-act — the exact shape of the
+PR 7 tenant-cap steal.  Both the check and the act correctly take the
+registry's lock (so pass #3 sees nothing), but in TWO separate
+acquisitions: two concurrent ``admit`` calls can both read ``len == cap-1``
+before either registers, and both insert — the cap is pierced.
+
+Expected findings: exactly two TOCTOU — the tainted-count steal in
+``admit`` and the direct membership-check steal in ``put_once``.
+Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class BadCaps:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}  # guarded-by: _lock
+
+    def admit(self, key, job, cap):
+        with self._lock:
+            live = len(self._jobs)
+        if live < cap:
+            # BUG: the cap check used a COUNT from a previous acquisition;
+            # a concurrent admit interleaves between the regions
+            with self._lock:
+                self._jobs[key] = job
+                return True
+        return False
+
+    def put_once(self, key, val):
+        with self._lock:
+            present = key in self._jobs
+        if not present:
+            with self._lock:
+                # BUG: same split — two put_once calls both see absent
+                self._jobs[key] = val

@@ -1,0 +1,43 @@
+"""The well-formed twin of bad_holdslock.py: every ``# holds-lock:``
+contract is honored at every call site, a declared helper touches only
+state its declaration covers, and a two-lock helper declares both.
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class GoodRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mu = threading.Lock()
+        self._jobs = {}  # guarded-by: _lock
+        self._stats = {}  # guarded-by: _mu
+
+    # holds-lock: _lock
+    def _evict(self, key):
+        self._jobs.pop(key, None)
+
+    # holds-lock: _lock, _mu
+    def _account(self, key):
+        # both registries move together; the contract declares both locks
+        self._stats[key] = len(self._jobs)
+
+    def shutdown(self, key):
+        with self._lock:
+            self._evict(key)
+
+    def rebalance(self, key):
+        with self._lock:
+            with self._mu:
+                self._account(key)
+
+    # holds-lock: _lock
+    def _chain(self, key):
+        # a holds-lock function may call another with the same contract:
+        # the declared entry set satisfies the callee
+        self._evict(key)
+
+    def flush(self, key):
+        with self._lock:
+            self._chain(key)

@@ -1,0 +1,42 @@
+"""The well-formed twin of bad_lockorder.py: every path acquires in the
+one declared order (``# lock-order: _ADMIT < _STATE``), the helper's
+nested acquisition agrees with it interprocedurally, and the RLock's
+re-entrant self-acquisition (the server ``_admission`` shape) is exempt.
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+# lock-order: _ADMIT < _STATE
+
+_ADMIT = threading.Lock()
+_STATE = threading.Lock()
+_REENTRANT = threading.RLock()
+
+
+def drain():
+    with _ADMIT:
+        _flush()
+
+
+def _flush():
+    with _STATE:
+        pass
+
+
+def rebalance():
+    # same order as drain: _ADMIT first, then the nested _STATE
+    with _ADMIT:
+        with _STATE:
+            pass
+
+
+def admit():
+    with _REENTRANT:
+        _account()
+
+
+def _account():
+    # re-entrant re-acquisition while already held: exempt (RLock)
+    with _REENTRANT:
+        pass

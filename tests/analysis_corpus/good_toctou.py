@@ -1,0 +1,44 @@
+"""The well-formed twin of bad_toctou.py: check and act share ONE
+acquisition (the atomic admission step), the double-checked-locking shape
+re-checks under the write's own acquisition, and a ``# holds-lock:``
+helper is one critical section by contract.  Expected findings: none.
+Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class GoodCaps:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}  # guarded-by: _lock
+
+    def admit(self, key, job, cap):
+        # check -> act is one atomic step under one acquisition
+        with self._lock:
+            if len(self._jobs) < cap:
+                self._jobs[key] = job
+                return True
+        return False
+
+    def put_once_fastpath(self, key, val):
+        with self._lock:
+            present = key in self._jobs
+        if not present:
+            with self._lock:
+                # the double-checked shape: the RE-CHECK under the write's
+                # own acquisition makes the outer stale read harmless
+                if key not in self._jobs:
+                    self._jobs[key] = val
+
+    # holds-lock: _lock
+    def _admit_locked(self, key, job, cap):
+        # the whole function is one critical section by contract
+        if len(self._jobs) < cap:
+            self._jobs[key] = job
+            return True
+        return False
+
+    def admit_via_helper(self, key, job, cap):
+        with self._lock:
+            return self._admit_locked(key, job, cap)

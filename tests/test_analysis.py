@@ -94,7 +94,8 @@ def test_cli_package_scan_exits_zero():
     assert "0 finding(s)" in proc.stderr
 
 
-def test_cli_list_passes_names_all_five():
+@pytest.mark.timeout_cap(120)
+def test_cli_list_passes_names_all_ten():
     proc = subprocess.run(
         [
             sys.executable,
@@ -114,6 +115,10 @@ def test_cli_list_passes_names_all_five():
         "lock-discipline",
         "trace-safety",
         "collective-discipline",
+        "holds-lock",
+        "lock-order",
+        "check-then-act",
+        "test-discipline",
     ):
         assert name in proc.stdout
 
@@ -286,6 +291,306 @@ def test_corpus_collgather():
     assert _analyze("good_collgather.py") == []
 
 
+def test_corpus_holdslock():
+    """The interprocedural contracts (ISSUE 12): a helper mutating under
+    its CALLER's lock declares '# holds-lock:'; pass #6 checks every call
+    site for the lock and the helper's guarded accesses against the
+    declared held set."""
+    findings = _analyze("bad_holdslock.py")
+    assert _codes(findings) == ["HELDLOCK", "NOHOLD"]
+    assert any("_evict" in f.message for f in findings)
+    assert any("self._stats" in f.message for f in findings)
+    assert _analyze("good_holdslock.py") == []
+
+
+def test_corpus_lockorder():
+    """The two-function deadlock (ISSUE 12): no single function acquires
+    both locks, so only the call-graph propagation can see the A->B->A
+    cycle; the report carries both acquisition chains as file:line."""
+    findings = _analyze("bad_lockorder.py")
+    assert _codes(findings) == ["LOCKORDER"]
+    msg = findings[0].message
+    assert "_ADMIT" in msg and "_STATE" in msg
+    assert "bad_lockorder.py:" in msg  # the file:line acquisition chains
+    assert _analyze("good_lockorder.py") == []
+
+
+def test_corpus_toctou():
+    """The split-lock check-then-act (ISSUE 12, the PR 7 tenant-cap steal
+    shape): both accesses correctly locked, but in two acquisitions."""
+    findings = _analyze("bad_toctou.py")
+    assert _codes(findings) == ["TOCTOU", "TOCTOU"]
+    assert all("self._jobs" in f.message for f in findings)
+    assert all("different" in f.message for f in findings)
+    assert _analyze("good_toctou.py") == []
+
+
+def test_interprocedural_cases_invisible_to_pass_three():
+    """The acceptance proof: each seeded interprocedural defect is INVISIBLE
+    to the intraprocedural lock pass (#3) — the new layer is the only
+    thing standing between these shapes and production."""
+    p3 = [analysis.load_passes()["lock-discipline"]]
+    for fixture in ("bad_holdslock.py", "bad_lockorder.py", "bad_toctou.py"):
+        findings = analysis.analyze_file(os.path.join(CORPUS, fixture), p3)
+        assert findings == [], (
+            f"{fixture} should be invisible to pass #3:\n"
+            + "\n".join(f.format() for f in findings)
+        )
+
+
+def test_holds_lock_across_modules_with_alias(tmp_path):
+    """The runtime/job.py shape: a class whose lock IS another module's
+    lock by reference ('# lock-alias:') — a call site holding the ALIASED
+    lock satisfies the callee's holds-lock contract, and the re-entrant
+    edge does not cycle."""
+    (tmp_path / "mgr.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            from wkr import Worker
+
+
+            class Boss:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def run(self, w: Worker):
+                    with self._lock:
+                        w._step()
+            """
+        )
+    )
+    (tmp_path / "wkr.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+
+            class Worker:
+                def __init__(self, boss_lock: threading.RLock):
+                    self._lock = boss_lock  # lock-alias: mgr._lock
+                    self._n = 0  # guarded-by: _lock
+
+                # holds-lock: _lock
+                def _step(self):
+                    self._n += 1
+            """
+        )
+    )
+    findings = analysis.analyze_paths([str(tmp_path)])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_lockorder_cycle_across_two_modules(tmp_path):
+    """A cross-MODULE inversion: modules a and b each take their own lock
+    then call into the other — only the project-wide graph sees it."""
+    (tmp_path / "moda.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import modb
+
+            _A = threading.Lock()
+
+
+            def into_b():
+                with _A:
+                    modb.locked_work()
+
+
+            def locked_work():
+                with _A:
+                    pass
+            """
+        )
+    )
+    (tmp_path / "modb.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import moda
+
+            _B = threading.Lock()
+
+
+            def locked_work():
+                with _B:
+                    pass
+
+
+            def into_a():
+                with _B:
+                    moda.locked_work()
+            """
+        )
+    )
+    findings = analysis.analyze_paths([str(tmp_path)])
+    assert _codes(findings) == ["LOCKORDER"]
+    assert "moda._A" in findings[0].message
+    assert "modb._B" in findings[0].message
+
+
+def test_declared_order_inversion_needs_no_reverse_path():
+    """'# lock-order: A < B' is a virtual edge: ONE real B-held-then-A
+    acquisition closes the cycle, so an inversion is caught before anyone
+    writes the forward path."""
+    findings = _src(
+        """
+        import threading
+
+        # lock-order: _A < _B
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def backwards():
+            with _B:
+                with _A:
+                    pass
+        """
+    )
+    assert _codes(findings) == ["LOCKORDER"]
+    assert "declared" in findings[0].message
+
+
+def test_non_reentrant_self_reacquisition_is_a_cycle():
+    """A plain Lock re-acquired while held deadlocks immediately; the
+    known re-entrant RLock shape (the server's _admission) is exempt —
+    good_lockorder.py pins the exemption."""
+    findings = _src(
+        """
+        import threading
+
+        _L = threading.Lock()
+
+        def outer():
+            with _L:
+                inner()
+
+        def inner():
+            with _L:
+                pass
+        """
+    )
+    assert _codes(findings) == ["LOCKORDER"]
+    assert "re-acquired" in findings[0].message
+
+
+def test_nohold_respects_with_nesting_and_chained_contracts():
+    findings = _src(
+        """
+        import threading
+
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+
+            # holds-lock: _lock
+            def _a(self):
+                self._b()  # ok: entry contract covers the callee's
+
+            # holds-lock: _lock
+            def _b(self):
+                self._d.clear()
+
+            def go(self):
+                with self._lock:
+                    self._a()
+
+            def bad(self):
+                self._b()
+        """
+    )
+    assert _codes(findings) == ["NOHOLD"]
+
+
+def test_lockorder_sees_through_recursion_regardless_of_order():
+    """Regression: acquisition sets are a worklist FIXPOINT, not a DFS
+    memo — with mutually recursive f<->g, an unrelated entry point
+    traversed first must not freeze g's set without f's lock (the DFS
+    memo missed the _L->_A inversion whenever h1 came before h2)."""
+    findings = _src(
+        """
+        import threading
+
+        # lock-order: _A < _L
+
+        _A = threading.Lock()
+        _L = threading.Lock()
+        _U = threading.Lock()
+
+        def h1():
+            with _U:
+                g()
+
+        def f():
+            with _A:
+                pass
+            g()
+
+        def g():
+            f()
+
+        def h2():
+            with _L:
+                g()
+        """
+    )
+    assert _codes(findings) == ["LOCKORDER"]
+
+
+def test_toctou_sees_mutator_calls_with_result_used():
+    """Regression: `val = self._d.pop(k)` / `if self._d.pop(k):` are the
+    same act as the bare statement — write detection must not require the
+    mutator call to be an expression statement."""
+    findings = _src(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+
+            def take(self, k):
+                with self._lock:
+                    present = k in self._d
+                if present:
+                    with self._lock:
+                        val = self._d.pop(k)
+                    return val
+        """
+    )
+    assert _codes(findings) == ["TOCTOU"]
+
+
+def test_toctou_recheck_under_write_lock_sanctions():
+    # the double-checked shape: good_toctou.py pins the full fixture; this
+    # probes the minimal form inline
+    findings = _src(
+        """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+
+            def put(self, k, v):
+                with self._lock:
+                    seen = k in self._d
+                if not seen:
+                    with self._lock:
+                        if k not in self._d:
+                            self._d[k] = v
+        """
+    )
+    assert findings == []
+
+
 def test_collgather_requires_a_reason():
     # a bare `# gather-ok` without a why does NOT sanction the site
     findings = _src(
@@ -390,6 +695,149 @@ def test_baseline_file_shape(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# machine-readable output + parallel scanning (ISSUE 12 satellites)
+
+
+@pytest.mark.timeout_cap(120)
+def test_cli_json_format_schema():
+    """--format json: the stable schema an external gate consumes —
+    file/line/pass/code/message/suppressed per finding — with suppressed
+    and grandfathered findings carried (suppressed=true) but not failing
+    the run."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "gelly_streaming_tpu.analysis",
+            "--format",
+            "json",
+            "--paths",
+            os.path.join(CORPUS, "bad_toctou.py"),
+            "--no-baseline",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert set(data) == {"findings", "summary"}
+    assert data["summary"]["new"] == 2
+    for row in data["findings"]:
+        assert set(row) == {
+            "file", "line", "pass", "code", "message", "suppressed",
+        }
+        assert row["code"] == "TOCTOU" and row["suppressed"] is False
+        assert row["file"].endswith("bad_toctou.py")
+        assert isinstance(row["line"], int)
+
+
+@pytest.mark.timeout_cap(120)
+def test_cli_json_marks_suppressed_and_exits_zero(tmp_path):
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import jax\n\n"
+        "step = jax.jit(lambda x: x)  # graft: disable=RAWJIT — probe\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "gelly_streaming_tpu.analysis",
+            "--format",
+            "json",
+            "--paths",
+            str(probe),
+            "--no-baseline",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["summary"]["new"] == 0
+    assert data["summary"]["suppressed"] == 1
+    assert [r["suppressed"] for r in data["findings"]] == [True]
+
+
+@pytest.mark.timeout_cap(180)
+def test_cli_parallel_jobs_match_serial():
+    """--jobs 2 (the 2-core host's gate speedup) must agree with the
+    serial scan bit-for-bit on the corpus findings."""
+    argv = [
+        sys.executable,
+        "-m",
+        "gelly_streaming_tpu.analysis",
+        "--paths",
+        CORPUS,
+        "--no-baseline",
+    ]
+    serial = subprocess.run(
+        argv, capture_output=True, text=True, cwd=REPO_ROOT
+    )
+    parallel = subprocess.run(
+        argv + ["--jobs", "2"], capture_output=True, text=True, cwd=REPO_ROOT
+    )
+    assert serial.returncode == parallel.returncode == 1
+    assert serial.stdout == parallel.stdout
+    assert len(serial.stdout.splitlines()) > 10  # the bad fixtures fired
+
+
+# ---------------------------------------------------------------------------
+# test-discipline (pass #9): the tests/ tree itself is gated
+
+
+def test_notimeout_pass_semantics():
+    with_threads = """
+        import threading
+
+        def test_spawns():
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()
+        """
+    assert _codes(_src(with_threads)) == ["NOTIMEOUT"]
+    capped = """
+        import threading
+        import pytest
+
+        @pytest.mark.timeout_cap(30)
+        def test_spawns():
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()
+        """
+    assert _src(capped) == []
+    marked_module = """
+        import threading
+        import pytest
+
+        pytestmark = pytest.mark.timeout_cap(300)
+
+        def test_spawns():
+            threading.Event().wait(0)
+        """
+    assert _src(marked_module) == []
+    pure = """
+        def test_pure_math():
+            assert 1 + 1 == 2
+        """
+    assert _src(pure) == []
+
+
+@pytest.mark.timeout_cap(120)
+def test_tests_tree_has_no_uncapped_concurrency_tests():
+    """The gate the satellite demands: every test_* under tests/ that
+    drives threads/sockets/subprocesses carries timeout_cap."""
+    pass_obj = [analysis.load_passes()["test-discipline"]]
+    findings = analysis.analyze_paths(
+        [os.path.dirname(__file__)], pass_obj, root=REPO_ROOT
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # framework details
 
 
@@ -403,7 +851,7 @@ def test_syntax_error_is_a_parse_finding():
     assert _codes(findings) == ["PARSE"]
 
 
-def test_registry_has_six_passes_in_order():
+def test_registry_has_ten_passes_in_order():
     passes = list(analysis.load_passes())
     assert passes == [
         "hot-loop",
@@ -412,6 +860,10 @@ def test_registry_has_six_passes_in_order():
         "lock-discipline",
         "trace-safety",
         "collective-discipline",
+        "holds-lock",
+        "lock-order",
+        "check-then-act",
+        "test-discipline",
     ]
 
 

@@ -22,6 +22,7 @@ import textwrap
 import time
 
 import numpy as np
+import pytest
 
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.library.connected_components import ConnectedComponents
@@ -141,6 +142,7 @@ _CHILD = textwrap.dedent(
 )
 
 
+@pytest.mark.timeout_cap(600)
 def test_unbounded_ingest_sigkill_resume_subprocess(tmp_path):
     """SIGKILL mid-stream while folding ingestion-time panes, resume from the
     on-disk snapshot: the non-idempotent edge count comes out exact."""

@@ -17,6 +17,11 @@ import pytest
 from gelly_streaming_tpu.core.types import EdgeBatch
 from gelly_streaming_tpu.parallel import multihost as mh
 
+# every test here drives ingest threads through the watermark board; a
+# wedged collective must fail the test, not the tier-1 run (the
+# test-discipline analyzer pass gates this)
+pytestmark = pytest.mark.timeout_cap(300)
+
 
 def _batches(edges, batch_size=4):
     """[(src, dst, t), ...] -> EdgeBatch iterator with event time."""

@@ -19,6 +19,10 @@ import textwrap
 
 import pytest
 
+# two real jax.distributed processes over a TCP coordinator: a wedged
+# barrier must fail here, not hang tier-1 (test-discipline pass gate)
+pytestmark = pytest.mark.timeout_cap(600)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = textwrap.dedent(

@@ -168,6 +168,7 @@ _CHILD = textwrap.dedent(
 )
 
 
+@pytest.mark.timeout_cap(600)
 def test_wire_checkpoint_sigkill_and_resume_subprocess(tmp_path):
     """SIGKILL the process mid-stream, resume from the on-disk snapshot: the
     non-idempotent edge count must come out exact (no batch folded twice or
