@@ -544,6 +544,12 @@ class ServerConfig:
         get per-(tenant, job) snapshot files derived from this prefix
         (utils.checkpoint.per_job_file) — the durable state that makes
         drain/restart resume bit-exactly.
+      decode_workers: size of the GIL-free native decode pool
+        (runtime/decode_pool.py) that validates + decodes pushed wire
+        buffers into transfer arenas off the interpreter.  -1 (default)
+        defers to the ``GELLY_DECODE_WORKERS`` env var (then the pool's
+        own default); 0 disables the pool — pushes take the pure-Python
+        decode path, the bit-identical equivalence oracle.
     """
 
     host: str = "127.0.0.1"
@@ -554,6 +560,7 @@ class ServerConfig:
     ingest_queue_batches: int = 64
     result_buffer_records: int = 1024
     checkpoint_prefix: "str | None" = None
+    decode_workers: int = -1
 
     def __post_init__(self):
         if not (0 <= self.port <= 65535):
@@ -566,6 +573,11 @@ class ServerConfig:
             raise ValueError("ingest_queue_batches must be positive")
         if self.result_buffer_records <= 0:
             raise ValueError("result_buffer_records must be positive")
+        if self.decode_workers < -1:
+            raise ValueError(
+                "decode_workers must be >= -1 (-1 defers to "
+                "GELLY_DECODE_WORKERS, 0 disables the decode pool)"
+            )
         ids = [t.tenant for t in self.tenants]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate tenant ids: {sorted(ids)}")

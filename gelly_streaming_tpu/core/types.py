@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional, Sequence
+from typing import ClassVar, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -126,6 +126,46 @@ class EdgeBatch:
         if pad_to is not None and pad_to != n:
             batch = batch.pad_to(pad_to)
         return batch
+
+    # shared all-ones host masks by size, read-only so every batch may
+    # alias one safely (the pane cutter np.asarray's it without writing)
+    _HOST_MASKS: ClassVar[dict] = {}
+
+    @staticmethod
+    def from_host_arrays(src, dst, pad_to: Optional[int] = None) -> "EdgeBatch":
+        """Host-plane batch: contiguous NUMPY int32 leaves, no device
+        conversion, the all-ones mask shared (read-only) across batches.
+
+        For value-less/untimed sources whose consumer is the HOST pane
+        cutter (core/windows.py ``np.asarray``'s every field before any
+        device work): ``from_arrays`` would round-trip each batch through
+        three eager jnp conversions (~ms-scale per batch — the measured
+        ceiling of the serving ingest path, ISSUE 14) only for the cutter
+        to convert straight back.  Numpy leaves are ordinary pytree
+        leaves, so consumers that DO dispatch a batch still work — they
+        pay the transfer exactly once, at the device boundary.
+        """
+        src = np.ascontiguousarray(src, dtype=np.int32)
+        dst = np.ascontiguousarray(dst, dtype=np.int32)
+        n = src.shape[0]
+        if dst.shape[0] != n:
+            raise ValueError("src/dst length mismatch")
+        size = n if pad_to is None else int(pad_to)
+        if size < n:
+            raise ValueError(f"cannot pad batch of size {n} down to {size}")
+        if size != n:
+            pad = size - n
+            src = np.concatenate([src, np.zeros(pad, np.int32)])
+            dst = np.concatenate([dst, np.zeros(pad, np.int32)])
+            mask = np.zeros(size, bool)
+            mask[:n] = True
+        else:
+            mask = EdgeBatch._HOST_MASKS.get(size)
+            if mask is None:
+                mask = np.ones(size, bool)
+                mask.flags.writeable = False
+                EdgeBatch._HOST_MASKS[size] = mask
+        return EdgeBatch(src=src, dst=dst, mask=mask)
 
     @staticmethod
     def from_edges(

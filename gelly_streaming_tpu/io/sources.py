@@ -314,6 +314,15 @@ class NetworkEdgeSource:
             if self._closed:
                 raise SourceQuiesced("source is closed (end-of-stream seen)")
 
+    def check_open(self) -> None:
+        """Raise ``SourceQuiesced`` unless pushes are currently accepted.
+
+        The decode pool's pre-flight: the pooled push path must refuse a
+        quiesced/closed source BEFORE spending a decode on the buffer —
+        the same refusal precedence ``push_wire`` has by construction
+        (its open check runs ahead of validation)."""
+        self._refuse_if_not_open()
+
     def push_wire(
         self,
         buf,
@@ -387,6 +396,36 @@ class NetworkEdgeSource:
         self._accept(s, d, timeout, offset)
         return len(s)
 
+    def push_decoded(
+        self,
+        src: np.ndarray,
+        dst: np.ndarray,
+        timeout: Optional[float] = None,
+        offset: Optional[int] = None,
+        release: Optional[Callable[[], None]] = None,
+    ) -> int:
+        """Queue one ALREADY-validated full batch — the decode pool's
+        landing path (runtime/decode_pool.py).
+
+        ``src``/``dst`` are int32[batch] rows of a pool transfer arena
+        that already passed the full ``validate_wire_buffer`` guard set
+        (size bounds, id decode, both ends of the id range) in the
+        pool's native pass; re-validating here would put the decode back
+        on this thread's interpreter time — exactly the cost the pool
+        exists to remove.  ``release`` travels with the batch: the
+        stream factory fires it after copying the rows out (the arena's
+        donation fence), returning the arena to the pool's free-list.
+        Same backpressure/refusal contract as ``push_wire`` otherwise.
+        """
+        self._refuse_if_not_open()
+        if len(src) != self.batch or len(dst) != self.batch:
+            raise ValueError(
+                f"decoded push must hold exactly {self.batch} edges, got "
+                f"{len(src)}/{len(dst)}"
+            )
+        self._accept(src, dst, timeout, offset, release)
+        return len(src)
+
     def _check_offset(self, offset: Optional[int]) -> None:
         if offset is None:
             return
@@ -400,7 +439,9 @@ class NetworkEdgeSource:
                 "not hold — re-push from the advertised resume cursor"
             )
 
-    def _accept(self, s, d, timeout: Optional[float], offset=None) -> None:
+    def _accept(
+        self, s, d, timeout: Optional[float], offset=None, release=None
+    ) -> None:
         # positional guard first: a stale pipelined frame must refuse, not
         # wait on (or worse, land in) a queue it has no position in.  The
         # check re-runs on blocked-push retries (the server's bounded-wait
@@ -409,8 +450,9 @@ class NetworkEdgeSource:
         self._check_offset(offset)
         # enqueue timestamp: the consumer side records queue residency as
         # the push-to-fold latency histogram (how long a pushed batch
-        # waited before the scheduler folded it)
-        self._q.put((s, d, time.perf_counter()), timeout=timeout)
+        # waited before the scheduler folded it).  ``release`` (decode-pool
+        # batches only) rides along so the factory can return the arena.
+        self._q.put((s, d, time.perf_counter(), release), timeout=timeout)
         with self._lock:
             self._edges_in += len(s)
         wake = self.on_data
@@ -570,10 +612,21 @@ class NetworkEdgeSource:
             with self._lock:
                 self._edges_out += n
             left -= n
-            yield EdgeBatch.from_arrays(zeros, zeros, pad_to=self.batch)
+            yield EdgeBatch.from_host_arrays(zeros, zeros, pad_to=self.batch)
         while True:
+            # end-of-stream must not cost a poll slice: once the source is
+            # closed the queue can only drain, so a non-blocking get is
+            # exact — the previous blocking get paid its full timeout ON
+            # THE SCHEDULER THREAD at every job's end before noticing the
+            # close (measured ~50 ms/job of serialized scheduler stall in
+            # the serving bench's fold phase)
+            with self._lock:
+                closed = self._closed
             try:
-                s, d, t_pushed = self._q.get(timeout=0.05)
+                if closed:
+                    s, d, t_pushed, release = self._q.get_nowait()
+                else:
+                    s, d, t_pushed, release = self._q.get(timeout=0.05)
             except queue.Empty:
                 with self._lock:
                     if self._closed and self._q.empty():
@@ -587,7 +640,16 @@ class NetworkEdgeSource:
             )
             with self._lock:
                 self._edges_out += len(s)
-            yield EdgeBatch.from_arrays(s, d, pad_to=self.batch)
+            if release is not None:
+                # the arena's donation fence: the host batch aliases its
+                # arrays (the ArenaPool ownership rule), so the rows are
+                # copied out BEFORE the arena rejoins the pool's free-list
+                s, d = np.array(s), np.array(d)
+                release()
+            # host-array batches: the pane cutter consumes numpy directly,
+            # so the per-batch jnp round trip (the measured ceiling of
+            # this path — ISSUE 14) never happens
+            yield EdgeBatch.from_host_arrays(s, d, pad_to=self.batch)
 
 
 def unbounded_generated_stream(

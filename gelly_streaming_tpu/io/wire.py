@@ -687,6 +687,99 @@ def pack_bucket_rows(
     return rows
 
 
+# ---------------------------------------------------------------------------
+# Serving-plane decode (ISSUE 14): one-pass native validate + decode (+ bin)
+# of a pushed wire buffer into caller-owned transfer arenas.  The numpy twin
+# below is the equivalence oracle — the decode pool's GELLY_DECODE_WORKERS=0
+# path and the refusal phrasing both come from it, so the native fast path
+# can never drift observably from the pure-Python plane.
+
+# decode_wire_into's native width codes: fixed byte widths pass through,
+# PAIR40/BDV get codes past the byte widths (EF40 never crosses the push
+# boundary — width_for_capacity never returns it)
+_NATIVE_DECODE_CODES = {2: 2, 3: 3, 4: 4, PAIR40: 5}
+
+
+def decode_wire_np(buf, n: int, width, capacity: int, sort: bool = False):
+    """Numpy twin of the native ``decode_wire_into``: the full
+    ``core/stream.validate_wire_buffer`` guard set (size bounds, host
+    decode, BOTH ends of the id range) plus the optional (dst, src)
+    binning pass.  This is the oracle: its typed ``ValueError``s are the
+    refusals the serving plane sends, whichever implementation ran."""
+    from ..core.stream import validate_wire_buffer
+
+    s, d = validate_wire_buffer(buf, n, width, capacity, decode_ids=True)
+    if sort:
+        s, d = sort_edges_binned(s, d, capacity)
+    return s, d
+
+
+def decode_wire_into(
+    buf,
+    n: int,
+    width,
+    capacity: int,
+    out_src: np.ndarray,
+    out_dst: np.ndarray,
+    sort: bool = False,
+) -> bool:
+    """Native one-pass validate + decode (+ bin) of one wire buffer into
+    ``out_src``/``out_dst`` (contiguous int32[n], e.g. the rows of a
+    decode-pool transfer arena), with the GIL released for the whole call.
+
+    Returns True when the native path ran and validated the buffer; False
+    when it is unavailable (no compiled library, an encoding it does not
+    cover, an internal fallback) — the caller then runs ``decode_wire_np``.
+    A REFUSED buffer raises the oracle's own typed ``ValueError``: the
+    native code only detects, the numpy twin phrases, so the error surface
+    is byte-identical to the pure-Python path by construction.
+    """
+    code = (
+        6
+        if (isinstance(width, tuple) and width[0] == BDV)
+        else _NATIVE_DECODE_CODES.get(width)
+    )
+    lib = load_ingest_lib()
+    if code is None or lib is None or not hasattr(lib, "decode_wire_into"):
+        return False
+    b = np.asarray(buf)
+    if (
+        b.dtype != np.uint8
+        or not b.flags.c_contiguous
+        or out_src.dtype != np.int32
+        or out_dst.dtype != np.int32
+        or out_src.shape != (n,)
+        or out_dst.shape != (n,)
+        or not out_src.flags.c_contiguous
+        or not out_dst.flags.c_contiguous
+    ):
+        return False  # odd layouts take the twin (which also phrases dtype refusals)
+    rc = lib.decode_wire_into(
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        b.nbytes,
+        n,
+        code,
+        capacity,
+        1 if sort else 0,
+        out_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc == n:
+        return True
+    if rc == -4:
+        # internal (alloc failure / sort bounds): not a client refusal —
+        # the numpy twin serves the request instead
+        return False
+    # typed refusal: let the oracle raise the canonical error for THIS
+    # buffer; reaching past it means the two decoders disagree, which the
+    # fuzz suite (tests/test_decode_pool.py) pins as unreachable
+    decode_wire_np(buf, n, width, capacity, sort=sort)
+    raise RuntimeError(
+        f"native decode refused (rc={rc}) a buffer the numpy oracle "
+        "accepts — decoder drift; re-run tests/test_decode_pool.py"
+    )
+
+
 def unpack_edges_host(buf: np.ndarray, n: int, width):
     """Host-side (numpy) decode of one wire buffer -> (src, dst) int32[n].
 

@@ -962,3 +962,202 @@ extern "C" int64_t flink_proxy_degrees(const int32_t* src, const int32_t* dst,
   if (consumed != n) return -1;
   return std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0).count();
 }
+
+// ---------------------------------------------------------------------------
+// Serving data plane (ISSUE 14): the connection->arena hot path in native
+// form.  The serving bench pinned the frontend at ~0.4x the in-process rate
+// because GLY1 frame parsing, wire decode-validation, and repack all shared
+// the GIL with the scheduler and fold drain.  These entry points let the
+// decode pool (runtime/decode_pool.py) run the whole push path — frame
+// bounds checks, buffer validation, id decode, and (dst, src) binning —
+// off the interpreter: ctypes releases the GIL for the duration of each
+// call, and the decoded rows land directly in the caller's transfer arena.
+//
+// Contract discipline: these functions DETECT and refuse with negative
+// codes; the Python wrapper re-runs the numpy oracle on any refusal so the
+// typed error (and its message) is byte-identical to the pure-Python path.
+
+extern "C" {
+
+// Validate one 12-byte GLY1 frame prefix (magic + big-endian header/payload
+// lengths — runtime/protocol.py's frame grammar).  Always writes the two
+// decoded lengths (the Python side phrases its typed errors from them).
+// Returns 0 ok, -1 bad magic, -2 header over max_header, -3 payload over
+// max_payload — the same refusal taxonomy as protocol.read_frame.
+int32_t gly1_probe_prefix(const uint8_t* prefix, int64_t max_header,
+                          int64_t max_payload, int64_t* header_len,
+                          int64_t* payload_len) {
+  uint32_t h = (uint32_t(prefix[4]) << 24) | (uint32_t(prefix[5]) << 16) |
+               (uint32_t(prefix[6]) << 8) | uint32_t(prefix[7]);
+  uint32_t p = (uint32_t(prefix[8]) << 24) | (uint32_t(prefix[9]) << 16) |
+               (uint32_t(prefix[10]) << 8) | uint32_t(prefix[11]);
+  *header_len = (int64_t)h;
+  *payload_len = (int64_t)p;
+  if (prefix[0] != 'G' || prefix[1] != 'L' || prefix[2] != 'Y' ||
+      prefix[3] != '1') {
+    return -1;
+  }
+  if ((int64_t)h > max_header) return -2;
+  if ((int64_t)p > max_payload) return -3;
+  return 0;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Fixed-width block decode: src block then dst block, each id `w`
+// little-endian bytes (io/wire.py pack_edges layout).
+void decode_fixed_blocks(const uint8_t* buf, int64_t n, int32_t w,
+                         int32_t* out_src, int32_t* out_dst) {
+  int32_t* outs[2] = {out_src, out_dst};
+  for (int b = 0; b < 2; ++b) {
+    const uint8_t* q = buf + (int64_t)b * n * w;
+    int32_t* out = outs[b];
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t v = 0;
+      for (int32_t k = 0; k < w; ++k) v |= (uint32_t)q[k] << (8 * k);
+      out[i] = (int32_t)v;
+      q += w;
+    }
+  }
+}
+
+// 40-bit pair decode (io/wire.py _unpack_edges40): 5 bytes per edge, src in
+// bits 0..19, dst in bits 20..39.
+void decode_pair40(const uint8_t* buf, int64_t n, int32_t* out_src,
+                   int32_t* out_dst) {
+  const uint8_t* q = buf;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t lo = (uint32_t)q[0] | ((uint32_t)q[1] << 8) |
+                  ((uint32_t)q[2] << 16);
+    uint32_t hi = ((uint32_t)q[2] >> 4) | ((uint32_t)q[3] << 4) |
+                  ((uint32_t)q[4] << 12);
+    out_src[i] = (int32_t)(lo & 0xFFFFF);
+    out_dst[i] = (int32_t)hi;
+    q += 5;
+  }
+}
+
+// BDV decode, the twin of io/wire.unpack_edges_bdv_host: 2n group varints
+// (control block of 2-bit lengths, then little-endian value bytes), dst as
+// unsigned deltas, src as global zigzag deltas — both one running sum, with
+// int64 accumulation truncated to int32 per element exactly like the numpy
+// path's cumsum().astype(int32).  Returns n, or -3 when the control block
+// declares more bytes than the buffer holds (truncation — the same refusal
+// _varint_decode_np phrases).
+int64_t decode_bdv_into(const uint8_t* buf, int64_t nbytes, int64_t n,
+                        int32_t* out_src, int32_t* out_dst) {
+  int64_t count = 2 * n;
+  int64_t ctrl = (count + 3) / 4;
+  if (nbytes < ctrl) return -3;
+  int64_t needed = ctrl;
+  for (int64_t k = 0; k < count; ++k) {
+    needed += ((buf[k >> 2] >> (2 * (k & 3))) & 3) + 1;
+  }
+  if (nbytes < needed) return -3;
+  const uint8_t* q = buf + ctrl;
+  int64_t d_acc = 0;
+  int64_t s_acc = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t vals2[2];
+    for (int v = 0; v < 2; ++v) {
+      int64_t k = 2 * i + v;
+      int32_t len = ((buf[k >> 2] >> (2 * (k & 3))) & 3) + 1;
+      uint32_t x = 0;
+      for (int32_t j = 0; j < len; ++j) x |= (uint32_t)(*q++) << (8 * j);
+      vals2[v] = x;
+    }
+    d_acc += (int64_t)vals2[0];
+    int64_t ds = (int64_t)(vals2[1] >> 1) ^ -(int64_t)(vals2[1] & 1);
+    s_acc += ds;
+    out_dst[i] = (int32_t)d_acc;
+    out_src[i] = (int32_t)s_acc;
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// One-pass validate + decode (+ optional (dst, src) binning) of a pushed
+// wire buffer into caller-owned int32[n] arrays — the decode pool's whole
+// per-buffer hot path in a single GIL-free call.
+//
+// width_code: 2/3/4 = fixed byte widths, 5 = PAIR40, 6 = BDV (io/wire.py
+// encodings; EF40 never crosses the push boundary).  sort != 0 applies
+// sort_edges_dst_src to the decoded batch in the same pass (requires
+// capacity within the sorter's 2^28 bound).
+//
+// Returns n on success; negative typed refusals the Python wrapper maps
+// back through the numpy oracle: -1 buffer size/bounds violation, -2 a
+// decoded id outside [0, capacity), -3 truncated BDV stream, -4 internal
+// (alloc failure / sort out of range) — the one code that means "fall back
+// to the numpy twin", never "refuse the client".
+int64_t decode_wire_into(const uint8_t* buf, int64_t nbytes, int64_t n,
+                         int32_t width_code, int32_t capacity, int32_t sort,
+                         int32_t* out_src, int32_t* out_dst) {
+  if (n <= 0 || capacity <= 0) return -1;
+  int32_t* s = out_src;
+  int32_t* d = out_dst;
+  int32_t* tmp = nullptr;
+  if (sort) {
+    tmp = static_cast<int32_t*>(malloc((size_t)n * 8));
+    if (!tmp) return -4;
+    s = tmp;
+    d = tmp + n;
+  }
+  int64_t rc = n;
+  switch (width_code) {
+    case 2:
+    case 3:
+    case 4:
+      if (nbytes != 2 * n * width_code) {
+        rc = -1;
+      } else {
+        decode_fixed_blocks(buf, n, width_code, s, d);
+      }
+      break;
+    case 5:
+      if (nbytes != 5 * n) {
+        rc = -1;
+      } else {
+        decode_pair40(buf, n, s, d);
+      }
+      break;
+    case 6: {
+      // the validation window of core/stream.validate_wire_buffer: BDV
+      // buffers are data-dependent sizes in [floor, worst-case bound]
+      int64_t bdv_min = (2 * n + 3) / 4 + 2 * n;
+      int64_t bdv_max = 9 * n;  // bdv_max_nbytes(n), value-less
+      if (nbytes > bdv_max || nbytes < bdv_min) {
+        rc = -1;
+      } else {
+        rc = decode_bdv_into(buf, nbytes, n, s, d);
+      }
+      break;
+    }
+    default:
+      rc = -4;  // unknown encoding: the Python twin owns it
+  }
+  if (rc >= 0) {
+    // both ends of the id range before anything is handed downstream
+    // (BDV's signed zigzag deltas can express negative ids, whose device
+    // scatters would silently wrap to the summary tail)
+    for (int64_t i = 0; i < n; ++i) {
+      if ((uint32_t)s[i] >= (uint32_t)capacity ||
+          (uint32_t)d[i] >= (uint32_t)capacity) {
+        rc = -2;
+        break;
+      }
+    }
+  }
+  if (rc >= 0 && sort) {
+    rc = sort_edges_dst_src(s, d, n, capacity, out_src, out_dst) == n ? n : -4;
+  }
+  free(tmp);
+  return rc;
+}
+
+}  // extern "C"

@@ -257,11 +257,23 @@ class GellyClient:
         )
         records: List[List[np.ndarray]] = []
         if head["count"]:
-            with np.load(_io.BytesIO(payload)) as data:
-                for i, n_leaves in enumerate(head["leaves"]):
-                    records.append(
-                        [data[f"r{i}_{j}"] for j in range(n_leaves)]
-                    )
+            # raw leaf framing: dtype/shape metadata in the header, the
+            # payload is the leaves' bytes concatenated in order (the
+            # server's _h_results twin — same leaves the npz container
+            # used to carry, without the per-record zipfile cost)
+            off = 0
+            for meta in head["leafmeta"]:
+                leaves = []
+                for dtype_str, shape in meta:
+                    dt = np.dtype(dtype_str)
+                    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                    nb = dt.itemsize * count
+                    arr = np.frombuffer(
+                        payload, dt, count=count, offset=off
+                    ).reshape(shape)
+                    leaves.append(arr)
+                    off += nb
+                records.append(leaves)
         return records, head["state"], bool(head["eos"])
 
     def iter_results(

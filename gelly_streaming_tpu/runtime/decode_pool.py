@@ -1,0 +1,251 @@
+"""GIL-free decode pool for the serving data plane (ISSUE 14).
+
+The serving bench pinned the frontend at ~0.4x the in-process rate: GLY1
+frame parsing, wire decode-validation, and repack all ran as Python/numpy
+on connection threads, timesharing the GIL with the scheduler and the
+fold drain.  This pool moves the per-push decode work off the
+interpreter: worker threads run the native ``decode_wire_into`` entry
+point (one C call per buffer — size bounds, id decode, BOTH ends of the
+id-range check, optional (dst, src) binning — with the GIL released for
+the duration) and land the decoded rows directly into recycled
+``ArenaPool`` transfer arenas, so ``NetworkEdgeSource`` receives
+ready-to-queue int32 rows instead of freshly allocated intermediate
+batches.
+
+Equivalence oracle: ``GELLY_DECODE_WORKERS=0`` (or
+``ServerConfig.decode_workers=0``) disables the pool and the server runs
+today's pure-Python path (``NetworkEdgeSource.push_wire`` over
+``validate_wire_buffer``).  The pool's refusals are the ORACLE'S: the
+native code only detects, and any refused buffer is re-run through the
+numpy twin (``io/wire.decode_wire_np``) to raise the canonical typed
+``ValueError`` — so the two paths are byte-identical in both accepted
+batches and refusal messages (pinned by tests/test_decode_pool.py).
+
+Threading/locking (the serving plane's lock hierarchy, pass #7/#8): the
+pool's completion lock is a LEAF — workers and waiters take it bare and
+call nothing under it; the submission queue's own mutex and the arena
+free-list lock (core/async_exec.ArenaPool._lock) are only ever taken in
+SEQUENCE with it, never nested.  Workers never touch the device: decode
+is host-side by construction (numpy + ctypes, no jax import in this
+module), so a decode worker can never introduce a device sync into the
+scheduler's dispatch overlap.
+"""
+# lock-order: server.StreamServer._admission < decode_pool.DecodePool._lock
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Tuple
+
+import numpy as np
+
+from gelly_streaming_tpu.core.async_exec import ArenaPool
+
+# default pool size when neither config nor env decides: two workers keeps
+# decode off the scheduler's core on this image's 2-core hosts without
+# oversubscribing it
+DEFAULT_DECODE_WORKERS = 2
+
+
+def resolve_decode_workers(requested: int = -1) -> int:
+    """Effective decode-pool size: explicit config (>= 0) wins, then the
+    ``GELLY_DECODE_WORKERS`` env var, then ``DEFAULT_DECODE_WORKERS``.
+
+    0 means "no pool": pushes take the pure-Python decode path — the
+    equivalence oracle.  An unparseable env spelling refuses loudly (the
+    same contract as the other data-plane switches in utils/envswitch.py)
+    rather than silently flipping the hot path.
+    """
+    if requested is not None and requested >= 0:
+        return int(requested)
+    env = os.environ.get("GELLY_DECODE_WORKERS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            raise ValueError(
+                f"GELLY_DECODE_WORKERS={env!r} is not an integer "
+                "(0 disables the decode pool)"
+            )
+    return DEFAULT_DECODE_WORKERS
+
+
+class DecodePoolClosed(RuntimeError):
+    """Decode refused because the pool is shutting down (server stop): the
+    connection gets a typed refusal instead of a wedged wait."""
+
+
+class DecodePool:
+    """N worker threads running native wire decode into transfer arenas.
+
+    ``decode()`` is called from connection handler threads: it enqueues
+    one request and blocks until a worker finishes it, returning
+    ``(src, dst, release)`` where ``src``/``dst`` are int32[batch] rows of
+    a pooled arena and ``release`` returns the arena to the free-list.
+    Ownership: the CALLER owns the arena from return until it either
+    hands it to the ingest queue (``NetworkEdgeSource.push_decoded``
+    passes ``release`` along; the stream factory fires it after copying
+    the rows out — the donation fence) or fails, in which case it must
+    fire ``release`` itself.
+
+    Results cross threads through a completion map under one leaf lock
+    (see the module docstring's hierarchy note); per-request condition
+    wakeups keep a slow client's wait from costing other connections
+    anything.
+    """
+
+    def __init__(self, workers: int, arena_per_shape: int = 16):
+        if workers <= 0:
+            raise ValueError("DecodePool needs workers >= 1 (0 = no pool)")
+        self.workers = int(workers)
+        # recycled (2, batch) int32 landing arenas; free-list guarded
+        # inside ArenaPool (async_exec.ArenaPool._free # guarded-by: _lock)
+        self._arenas = ArenaPool(per_shape=arena_per_shape)
+        # submission queue: bounded so a flood of pushing connections
+        # backpressures at submit, not in an unbounded request pile
+        self._subq: "queue.Queue" = queue.Queue(maxsize=4 * self.workers)
+        # the pool's ONE leaf lock: a Condition so completion wakeups and
+        # the guarded state share a single acquisition
+        self._lock = threading.Condition()
+        # completion queue: request id -> decoded rows or the refusal to
+        # re-raise; workers write, the submitting connection thread reaps
+        self._done: dict = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        # native-vs-twin served counts (the bench/status introspection)
+        self._stats = {"native": 0, "fallback": 0}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._run, name=f"gelly-decode-{i}", daemon=True
+            )
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- submit side (connection handler threads) ----------------------------
+
+    def decode(
+        self, buf, width, batch: int, capacity: int, sort: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray, "callable"]:
+        """Validate + decode one full wire buffer on the pool.
+
+        Blocks until a worker completes it.  Raises the numpy oracle's
+        typed ``ValueError`` for a refused buffer (byte-identical to the
+        Python path's), ``DecodePoolClosed`` when the pool is stopping.
+        """
+        if self._stop.is_set():
+            raise DecodePoolClosed("decode pool is stopping")
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        self._subq.put((rid, buf, width, batch, capacity, sort))
+        with self._lock:
+            while rid not in self._done:
+                if self._stop.is_set():
+                    raise DecodePoolClosed("decode pool is stopping")
+                self._lock.wait(0.1)
+            out = self._done.pop(rid)
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _decode_one(self, buf, width, batch, capacity, sort):
+        from gelly_streaming_tpu.core.stream import validate_wire_width
+        from gelly_streaming_tpu.io import wire
+
+        # the same guard order as NetworkEdgeSource.push_wire: width
+        # first, then the buffer (refusal precedence is part of the
+        # oracle contract)
+        validate_wire_width(width, capacity)
+        arena = self._arenas.acquire((2, batch), np.int32)
+        try:
+            out_src, out_dst = arena[0], arena[1]
+            # GIL released inside the ctypes call: frame bytes -> arena
+            # rows without the interpreter on the critical path
+            native = wire.decode_wire_into(
+                buf, batch, width, capacity, out_src, out_dst, sort=sort
+            )
+            if not native:
+                s, d = wire.decode_wire_np(
+                    buf, batch, width, capacity, sort=sort
+                )
+                out_src[:] = s
+                out_dst[:] = d
+            with self._lock:
+                self._stats["native" if native else "fallback"] += 1
+        except BaseException:
+            self._arenas.release(arena)
+            raise
+        release = _ArenaRelease(self._arenas, arena)
+        return out_src, out_dst, release
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req = self._subq.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            rid, buf, width, batch, capacity, sort = req
+            try:
+                out = self._decode_one(buf, width, batch, capacity, sort)
+            except BaseException as e:
+                out = e
+            with self._lock:
+                self._done[rid] = out
+                self._lock.notify_all()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the workers and fail any still-blocked ``decode`` calls
+        (their waits see the stop flag within one poll slice).  Idempotent;
+        arenas still held by queued batches drain through their own
+        ``release`` callbacks (or the GC, if their job died with them)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        while True:
+            try:
+                self._subq.get_nowait()
+            except queue.Empty:
+                break
+        with self._lock:
+            # unreaped results (their waiter already gave up): return
+            # their arenas to the free-list before dropping them
+            for out in self._done.values():
+                if isinstance(out, tuple):
+                    out[2]()
+            self._done.clear()
+            self._lock.notify_all()
+
+    def __enter__(self) -> "DecodePool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _ArenaRelease:
+    """One-shot arena return: safe to fire from whichever thread ends up
+    owning the decoded rows (the stream factory's copy fence, or the
+    server's error path), and inert on double-fire."""
+
+    __slots__ = ("_pool", "_arena")
+
+    def __init__(self, pool: ArenaPool, arena: np.ndarray):
+        self._pool = pool
+        self._arena = arena
+
+    def __call__(self) -> None:
+        arena, self._arena = self._arena, None
+        if arena is not None:
+            self._pool.release(arena)

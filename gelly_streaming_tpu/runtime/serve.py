@@ -124,6 +124,14 @@ def main(argv=None) -> int:
         "checkpoint_prefix)",
     )
     parser.add_argument(
+        "--decode-workers",
+        type=int,
+        default=-1,
+        help="GIL-free native decode pool size for pushed wire buffers "
+        "(runtime/decode_pool.py); -1 defers to GELLY_DECODE_WORKERS, "
+        "0 disables the pool (the pure-Python equivalence-oracle path)",
+    )
+    parser.add_argument(
         "--jobs", type=int, default=2, help="synthetic same-shape job count"
     )
     parser.add_argument(
@@ -287,6 +295,7 @@ def _serve_listen(args, conf, specs, rt_cfg, sink, prefix) -> int:
         port=int(port_s),
         tenants=tenants,
         checkpoint_prefix=args.checkpoint_prefix or prefix,
+        decode_workers=args.decode_workers,
     )
     with JobManager(rt_cfg) as manager:
         with StreamServer(manager, srv_cfg) as server:

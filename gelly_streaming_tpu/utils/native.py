@@ -19,17 +19,18 @@ _REPO_ROOT = os.path.dirname(_PKG_ROOT)
 
 
 def _find_src():
-    """The C++ source: repo layout (native/) or installed package data
-    (gelly_streaming_tpu/native_src/, shipped so pip installs keep the native
-    ingest path instead of silently falling back to numpy).  Returns
-    (path, is_repo_layout)."""
-    repo_src = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
-    if os.path.exists(repo_src):
-        return repo_src, True
+    """The canonical C++ source is the PACKAGED copy
+    (gelly_streaming_tpu/native_src/edge_parser.cpp — shipped as package
+    data so pip installs keep the native ingest path); the repo-layout
+    ``native/edge_parser.cpp`` is a one-``#include`` reference stub, so
+    there is exactly one source of truth to edit (the drift guard is
+    tests/test_native_source_sync.py).  Returns (path, is_repo_layout) —
+    the layout flag only picks where builds land."""
     pkg_src = os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp")
+    repo_stub = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
     if os.path.exists(pkg_src):
-        return pkg_src, False
-    return repo_src, True
+        return pkg_src, os.path.exists(repo_stub)
+    return repo_stub, True
 
 
 _SRC, _IS_REPO_LAYOUT = _find_src()
@@ -215,43 +216,48 @@ def load_ingest_lib():
                 ctypes.c_int64,
             ]
             lib.pack_edges_ef40.restype = ctypes.c_int64
+        # serving data plane (ISSUE 14): GLY1 frame probe + one-pass wire
+        # decode into transfer arenas (runtime/protocol.py, io/wire.py)
+        if hasattr(lib, "gly1_probe_prefix"):
+            lib.gly1_probe_prefix.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.gly1_probe_prefix.restype = ctypes.c_int32
+        if hasattr(lib, "decode_wire_into"):
+            lib.decode_wire_into.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.decode_wire_into.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
 
-def sync_packaging_copy() -> bool:
-    """Copy the authoritative C++ source (native/edge_parser.cpp) over the
-    pip-packaging copy (gelly_streaming_tpu/native_src/edge_parser.cpp).
-
-    ``native/`` is the ONE source of truth; the package-data copy exists
-    only so pip installs keep the native ingest path.  A guard test
-    (tests/test_native_source_sync.py) fails whenever the two differ, and
-    this helper (``python -m gelly_streaming_tpu.utils.native --sync``) is
-    the prescribed fix.  Returns True when a copy was needed.
-    """
-    import shutil
-
-    src = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
-    dst = os.path.join(_PKG_ROOT, "native_src", "edge_parser.cpp")
-    with open(src, "rb") as f:
-        want = f.read()
-    try:
-        with open(dst, "rb") as f:
-            have = f.read()
-    except OSError:
-        have = None
-    if have == want:
-        return False
-    shutil.copyfile(src, dst)
-    return True
+# The repo-layout stub's entire sanctioned contents: one include of the
+# canonical packaged source (plus comments).  There is no longer a second
+# copy to hand-sync — the old ``--sync`` helper copied native/ over the
+# packaging copy; single-sourcing made it (and the drift it managed)
+# structurally impossible, and the guard test now pins THIS shape instead.
+STUB_INCLUDE_LINE = '#include "../gelly_streaming_tpu/native_src/edge_parser.cpp"'
 
 
-if __name__ == "__main__":
-    import sys as _sys
-
-    if "--sync" in _sys.argv:
-        print(
-            "packaging copy updated"
-            if sync_packaging_copy()
-            else "packaging copy already in sync"
-        )
+def stub_is_reference_only(path: "str | None" = None) -> bool:
+    """True iff the repo-layout ``native/edge_parser.cpp`` carries no code
+    of its own: every non-empty line is a comment except exactly one line,
+    the canonical include (``STUB_INCLUDE_LINE``)."""
+    if path is None:
+        path = os.path.join(_REPO_ROOT, "native", "edge_parser.cpp")
+    with open(path, "r", encoding="utf-8") as f:
+        lines = [ln.strip() for ln in f]
+    code = [ln for ln in lines if ln and not ln.startswith("//")]
+    return code == [STUB_INCLUDE_LINE]

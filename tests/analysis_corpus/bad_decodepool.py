@@ -1,0 +1,52 @@
+"""Seeded bugs: the decode-pool discipline violations graftcheck must
+catch (ISSUE 14).
+
+* the worker publishes into the ``# guarded-by: _lock`` completion queue
+  WITHOUT the lock — the lost-update a reaping connection thread cannot
+  reproduce in an interleaving test (one UNGUARDED), and the arena
+  free-list's unlocked check-then-pop adds two more (both accesses);
+* the worker's hot region materializes a device array per request
+  (``np.asarray``) — a per-buffer device sync inside the decode loop,
+  exactly the lockstep the GIL-free pool exists to remove (one HOTSYNC).
+
+Expected findings: exactly
+["HOTSYNC", "UNGUARDED", "UNGUARDED", "UNGUARDED"].
+Analyzer input only — never imported.
+"""
+
+import threading
+
+import numpy as np
+
+
+def native_decode_into(buf, arena):
+    return len(buf)
+
+
+class BadDecodePool:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._alock = threading.Lock()
+        self._free = []  # guarded-by: _alock
+        self._done = {}  # guarded-by: _lock
+
+    def reap(self, rid):
+        with self._lock:
+            while rid not in self._done:
+                self._lock.wait(0.1)
+            return self._done.pop(rid)
+
+    def worker(self, requests, device_probe):
+        # hot-loop: decode worker
+        for rid, buf in requests:
+            arena = (
+                self._free.pop()  # BUG: free-list touched without _alock
+                if self._free
+                else bytearray(64)
+            )
+            rows = native_decode_into(buf, arena)
+            np.asarray(device_probe)  # BUG: device sync per decoded buffer
+            # BUG: completion queue published without _lock — a racing
+            # reap() can read a half-updated map and lose this result
+            self._done[rid] = (rows, arena)
+        # hot-loop-end

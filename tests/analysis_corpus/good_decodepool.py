@@ -1,0 +1,57 @@
+"""The well-formed twin of bad_decodepool.py: a decode-pool shaped class
+holding the serving data plane's lock discipline (ISSUE 14) — the arena
+free-list and the completion queue each annotated ``# guarded-by:`` and
+only ever touched under their locks, the worker loop's hot region free of
+device syncs (native decode only), and the pool lock declared a leaf of
+the server hierarchy.  Expected findings: none.  Analyzer input only —
+never imported.
+"""
+# lock-order: server.StreamServer._admission < good_decodepool.GoodDecodePool._lock
+
+import threading
+
+
+def native_decode_into(buf, arena):
+    """Stand-in for the ctypes call (GIL released, no device access)."""
+    return len(buf)
+
+
+class GoodDecodePool:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._alock = threading.Lock()
+        # recycled landing arenas
+        self._free = []  # guarded-by: _alock
+        # completion queue: request id -> decoded rows
+        self._done = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+
+    def acquire_arena(self):
+        with self._alock:
+            return self._free.pop() if self._free else bytearray(64)
+
+    def release_arena(self, arena):
+        with self._alock:
+            self._free.append(arena)
+
+    def submit(self, buf):
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        return rid
+
+    def reap(self, rid):
+        with self._lock:
+            while rid not in self._done:
+                self._lock.wait(0.1)
+            return self._done.pop(rid)
+
+    def worker(self, requests):
+        # hot-loop: decode worker (native calls only — no device syncs)
+        for rid, buf in requests:
+            arena = self.acquire_arena()
+            rows = native_decode_into(buf, arena)
+            with self._lock:
+                self._done[rid] = (rows, arena)
+                self._lock.notify_all()
+        # hot-loop-end

@@ -303,6 +303,38 @@ def test_corpus_holdslock():
     assert _analyze("good_holdslock.py") == []
 
 
+def test_corpus_decodepool():
+    """The serving data plane's decode pool discipline (ISSUE 14): the
+    arena free-list and completion queue stay under their declared locks
+    and the worker's hot region stays free of device syncs — the good
+    twin also carries the pool's lock-order declaration under the server
+    hierarchy, and must scan clean with it."""
+    findings = _analyze("bad_decodepool.py")
+    assert _codes(findings) == [
+        "HOTSYNC",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+    ]
+    assert any("self._done" in f.message for f in findings)
+    assert any("self._free" in f.message for f in findings)
+    assert any("np.asarray" in f.message for f in findings)
+    assert _analyze("good_decodepool.py") == []
+
+
+def test_decode_pool_module_in_default_scan_paths():
+    """runtime/decode_pool.py must sit inside the default --paths set, so
+    the package gate (and the CLI default scan) covers the new module's
+    lock discipline without anyone remembering to add it."""
+    root = analysis.package_root()
+    mod = os.path.join(root, "runtime", "decode_pool.py")
+    assert os.path.exists(mod)
+    findings = analysis.analyze_paths([mod], root=REPO_ROOT)
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    new, _old = analysis.apply_baseline(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
 def test_corpus_lockorder():
     """The two-function deadlock (ISSUE 12): no single function acquires
     both locks, so only the call-graph propagation can see the A->B->A

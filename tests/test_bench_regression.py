@@ -78,6 +78,16 @@ def test_direction_rules():
     assert bench._bench_direction("rescale_resume_edges") is None
     assert bench._bench_direction("edges") is None
     assert bench._bench_direction("link_regime") is None
+    # the serving data plane's first-class keys (ISSUE 14): the
+    # server-vs-in-process ratio regresses downward (the 0.4 -> 0.8 climb
+    # is pinned), push-to-fold latency upward; the decode-pool shape
+    # figures are informational only
+    assert bench._bench_direction("serving_vs_inprocess_ratio") == "higher"
+    assert bench._bench_direction("serving_vs_inprocess_ratio_4") == "higher"
+    assert bench._bench_direction("serving_push_to_fold_p50_ms") == "lower"
+    assert bench._bench_direction("serving_push_to_fold_p99_ms") == "lower"
+    assert bench._bench_direction("serving_decode_workers") is None
+    assert bench._bench_direction("serving_decode_native") is None
 
 
 def test_fresh_at_best_passes(baselines, capsys):

@@ -1,0 +1,515 @@
+"""Lock-free serving data plane (ISSUE 14): native frame reader + decode
+pool vs the pure-Python oracle.
+
+The contracts under test:
+
+* DECODE EQUIVALENCE — the native one-pass ``decode_wire_into`` (and the
+  pool built on it) is bit-identical to the ``validate_wire_buffer``
+  numpy oracle on well-formed buffers of every push encoding (fixed
+  widths, PAIR40, BDV), and raises the IDENTICAL typed refusal (message
+  included) on garbage, truncated, oversized, negative-id, and
+  boundary-varint buffers.
+* FRAME EQUIVALENCE — the native GLY1 prefix probe and the Python parser
+  produce identical outcomes (accept/``BadFrame``/``FrameTooLarge``,
+  messages included) over fuzzed prefixes, and ``FrameReader``'s
+  arena-reuse read path yields the same (header, payload) sequence as
+  ``read_frame``.
+* SERVER EQUIVALENCE — the same stream through ``decode_workers=0`` (the
+  oracle) and a live pool produces bit-identical emission leaves, with
+  refusals surviving the connection either way.
+* SOAK — multiple clients over the pool with a non-idempotent fold:
+  exact counts, 0 recompiles, arenas recycled.
+"""
+
+import io
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.config import (
+    RuntimeConfig,
+    ServerConfig,
+    StreamConfig,
+)
+from gelly_streaming_tpu.core.stream import validate_wire_buffer
+from gelly_streaming_tpu.io import wire
+from gelly_streaming_tpu.runtime import JobManager
+from gelly_streaming_tpu.runtime import protocol
+from gelly_streaming_tpu.runtime.client import GellyClient, ServerRefused
+from gelly_streaming_tpu.runtime.decode_pool import (
+    DecodePool,
+    resolve_decode_workers,
+)
+from gelly_streaming_tpu.runtime.server import StreamServer, record_leaves
+from gelly_streaming_tpu.utils.native import load_ingest_lib
+
+pytestmark = pytest.mark.timeout_cap(300)
+
+HAVE_NATIVE = (
+    load_ingest_lib() is not None
+    and hasattr(load_ingest_lib(), "decode_wire_into")
+)
+
+CAP = 1 << 12
+W = 1 << 10
+B = 1 << 9
+N = 4 * W
+
+
+def _graph(seed, n=N, cap=CAP):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, cap, n).astype(np.int32),
+        rng.integers(0, cap, n).astype(np.int32),
+    )
+
+
+def _oracle(buf, n, width, capacity, sort=False):
+    """(src, dst) or the raised ValueError, from the pure-Python path."""
+    try:
+        return wire.decode_wire_np(buf, n, width, capacity, sort=sort), None
+    except ValueError as e:
+        return None, e
+
+
+def _native(buf, n, width, capacity, sort=False):
+    """(src, dst) or the raised ValueError, via decode_wire_into."""
+    out_s = np.empty(n, np.int32)
+    out_d = np.empty(n, np.int32)
+    try:
+        ran = wire.decode_wire_into(
+            buf, n, width, capacity, out_s, out_d, sort=sort
+        )
+    except ValueError as e:
+        return None, e
+    if not ran:
+        return None, "unavailable"
+    return (out_s, out_d), None
+
+
+# ---------------------------------------------------------------------------
+# decode equivalence: well-formed buffers, every encoding
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no native toolchain")
+@pytest.mark.parametrize(
+    "cap,width",
+    [
+        (1 << 12, 2),
+        (1 << 16, 2),
+        (1 << 18, wire.PAIR40),
+        (1 << 22, 3),
+        (1 << 26, 4),
+        (1 << 12, (wire.BDV, 1 << 12)),
+        (1 << 20, (wire.BDV, 1 << 20)),
+    ],
+)
+def test_native_decode_bit_identical_on_valid_buffers(cap, width):
+    rng = np.random.default_rng(hash(str(width)) % (1 << 32))
+    for n in (1, 7, 256, 1024):
+        s = rng.integers(0, cap, n).astype(np.int32)
+        d = rng.integers(0, cap, n).astype(np.int32)
+        buf = wire.pack_edges(s, d, width)
+        for sort in (False, True):
+            got, err = _native(buf, n, width, cap, sort=sort)
+            assert err is None, err
+            want, werr = _oracle(buf, n, width, cap, sort=sort)
+            assert werr is None
+            assert np.array_equal(got[0], want[0])
+            assert np.array_equal(got[1], want[1])
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no native toolchain")
+def test_native_decode_boundary_varints_and_id_extremes():
+    """BDV deltas at every varint length boundary (1/2/3/4 bytes) and ids
+    at both ends of the range decode identically."""
+    cap = 1 << 20
+    width = (wire.BDV, cap)
+    # dst deltas straddling the 1/2/3-byte varint boundaries; src jumping
+    # max-negative/max-positive zigzag swings, ids touching 0 and cap-1
+    s = np.array([cap - 1, 0, cap - 1, 0, 1, cap - 1, 0, 2], np.int32)
+    d = np.array([0, 0xFF, 0x100, 0xFFFF, 0x10000, 0x10000, 0x1FFFF,
+                  cap - 1], np.int32)
+    order = np.lexsort((s, d))
+    s, d = s[order], d[order]
+    buf = wire.pack_edges_bdv(s, d, cap, sort=False)
+    n = len(s)
+    got, err = _native(buf, n, width, cap)
+    assert err is None
+    want, _ = _oracle(buf, n, width, cap)
+    assert np.array_equal(got[0], want[0])
+    assert np.array_equal(got[1], want[1])
+
+
+# ---------------------------------------------------------------------------
+# decode equivalence: refusals (identical typed error, identical message)
+# ---------------------------------------------------------------------------
+
+
+def _refusal_cases():
+    """(label, buf, n, width, capacity) malformed-buffer corpus."""
+    rng = np.random.default_rng(99)
+    cases = []
+    s, d = _graph(5, 64, CAP)
+    fixed = wire.pack_edges(s, d, 2)
+    # garbage bytes of the right size still decode — ids out of range
+    junk = rng.integers(0, 256, fixed.nbytes).astype(np.uint8)
+    cases.append(("garbage-right-size", junk, 64, 2, 100))
+    # truncated / oversized fixed buffers
+    cases.append(("fixed-truncated", fixed[:-3], 64, 2, CAP))
+    cases.append(
+        ("fixed-oversized", np.append(fixed, fixed[:5]), 64, 2, CAP)
+    )
+    # out-of-range ids (width can express past capacity)
+    big = np.full(64, CAP + 7, np.int32)
+    cases.append(("ids-past-cap", wire.pack_edges(big, big, 2), 64, 2, CAP))
+    # pair40 wrong size
+    p40 = wire.pack_edges(s, d, wire.PAIR40)
+    cases.append(("pair40-truncated", p40[:-1], 64, wire.PAIR40, CAP))
+    # BDV: below floor, above worst-case bound, declared-length truncation
+    bdv = wire.pack_edges_bdv(s, d, CAP)
+    cases.append(("bdv-below-floor", bdv[:16], 64, (wire.BDV, CAP), CAP))
+    cases.append(
+        (
+            "bdv-above-bound",
+            np.zeros(wire.bdv_max_nbytes(64) + 1, np.uint8),
+            64,
+            (wire.BDV, CAP),
+            CAP,
+        )
+    )
+    # control block declaring 4-byte varints the payload doesn't hold:
+    # all-0xFF control = every varint 4 bytes -> needed >> nbytes
+    torn = np.full(wire.bdv_max_nbytes(64) - 8, 0xFF, np.uint8)
+    cases.append(("bdv-declared-truncation", torn, 64, (wire.BDV, CAP), CAP))
+    # negative ids: a zigzag src delta that sums negative
+    sn = np.array([-5, 3], np.int32)
+    dn = np.array([1, 2], np.int32)
+    neg = wire._encode_bdv_np(sn, dn)
+    cases.append(("bdv-negative-src", neg, 2, (wire.BDV, CAP), CAP))
+    # fuzzed random BDV buffers across the legal size window (most refuse
+    # on truncation or range; any accepted ones must match bit-for-bit)
+    for k in range(12):
+        nb = int(
+            rng.integers(
+                (2 * 32 + 3) // 4 + 2 * 32, wire.bdv_max_nbytes(32) + 1
+            )
+        )
+        fuzz = rng.integers(0, 256, nb).astype(np.uint8)
+        cases.append((f"bdv-fuzz-{k}", fuzz, 32, (wire.BDV, CAP), CAP))
+    return cases
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no native toolchain")
+@pytest.mark.parametrize(
+    "label,buf,n,width,cap",
+    _refusal_cases(),
+    ids=[c[0] for c in _refusal_cases()],
+)
+def test_native_refusals_identical_to_oracle(label, buf, n, width, cap):
+    got, gerr = _native(buf, n, width, cap)
+    want, werr = _oracle(buf, n, width, cap)
+    assert gerr != "unavailable"
+    if werr is None:
+        assert gerr is None, f"{label}: native refused, oracle accepted"
+        assert np.array_equal(got[0], want[0])
+        assert np.array_equal(got[1], want[1])
+    else:
+        assert gerr is not None, f"{label}: native accepted, oracle refused"
+        assert str(gerr) == str(werr), label
+
+
+def test_pool_raises_oracle_refusals():
+    """Through the POOL (worker thread round trip), a refused buffer
+    raises the oracle's exact message and releases its arena."""
+    with DecodePool(2) as pool:
+        bad = np.zeros(7, np.uint8)
+        _want, werr = _oracle(bad, B, 2, CAP)
+        with pytest.raises(ValueError) as e:
+            pool.decode(bad, 2, B, CAP)
+        assert str(e.value) == str(werr)
+        # a good buffer still decodes after the refusal, on a recycled
+        # arena (free-list round trip)
+        s, d = _graph(11, B, CAP)
+        buf = wire.pack_edges(s, d, 2)
+        out_s, out_d, release = pool.decode(buf, 2, B, CAP)
+        assert np.array_equal(out_s, s) and np.array_equal(out_d, d)
+        release()
+
+
+# ---------------------------------------------------------------------------
+# frame-prefix probe + FrameReader equivalence
+# ---------------------------------------------------------------------------
+
+
+def _prefix_outcome(prefix, max_payload, native):
+    try:
+        return protocol.parse_prefix(prefix, max_payload, native=native), None
+    except (protocol.BadFrame, protocol.FrameTooLarge) as e:
+        return None, (type(e).__name__, str(e))
+
+
+@pytest.mark.skipif(
+    protocol._native_probe() is None, reason="no native toolchain"
+)
+def test_frame_prefix_probe_matches_python_parser():
+    rng = np.random.default_rng(23)
+    cases = [
+        struct.pack(">4sII", b"GLY1", 10, 20),
+        struct.pack(">4sII", b"GLY1", protocol.MAX_HEADER_BYTES + 1, 0),
+        struct.pack(">4sII", b"GLY1", 0, 1 << 30),
+        struct.pack(">4sII", b"NOPE", 3, 4),
+        b"GLY1" + b"\xff" * 8,  # giant lengths
+        b"\x00" * 12,
+    ] + [bytes(rng.integers(0, 256, 12, dtype=np.uint8)) for _ in range(64)]
+    for prefix in cases:
+        got = _prefix_outcome(prefix, 1 << 20, native=True)
+        want = _prefix_outcome(prefix, 1 << 20, native=False)
+        assert got == want, prefix.hex()
+
+
+def test_frame_reader_matches_read_frame_over_pipelined_frames():
+    frames = [
+        ({"verb": "ping", "k": i}, bytes([i] * (i * 37 % 2048)))
+        for i in range(16)
+    ]
+    blob = io.BytesIO()
+    for head, pay in frames:
+        protocol.write_frame(blob, head, pay)
+    # read_frame (allocating) path
+    blob.seek(0)
+    want = []
+    while True:
+        frame = protocol.read_frame(blob)
+        if frame is None:
+            break
+        want.append(frame)
+    # FrameReader (arena-reuse) path; payloads must be copied per read —
+    # the arena's documented validity window
+    blob.seek(0)
+    reader = protocol.FrameReader(blob)
+    got = []
+    while True:
+        frame = reader.read()
+        if frame is None:
+            break
+        head, view = frame
+        got.append((head, bytes(view)))
+    assert got == want
+
+
+def test_frame_reader_typed_failures_match():
+    # truncated mid-prefix
+    reader = protocol.FrameReader(io.BytesIO(protocol.MAGIC + b"\x00"))
+    with pytest.raises(protocol.BadFrame, match="mid-frame"):
+        reader.read()
+    # oversized declared payload, bytes unread
+    blob = io.BytesIO(struct.pack(">4sII", b"GLY1", 0, 1 << 20))
+    reader = protocol.FrameReader(blob, max_payload=1 << 10)
+    with pytest.raises(protocol.FrameTooLarge, match="frame cap"):
+        reader.read()
+    # clean EOF at a boundary
+    assert protocol.FrameReader(io.BytesIO(b"")).read() is None
+
+
+# ---------------------------------------------------------------------------
+# server-level equivalence + survival
+# ---------------------------------------------------------------------------
+
+
+def _run_server_stream(workers, seed=31, bdv=True, query="cc"):
+    s, d = _graph(seed)
+    leaves = []
+    with JobManager(RuntimeConfig()) as jm, StreamServer(
+        jm, ServerConfig(decode_workers=workers)
+    ) as server:
+        with GellyClient("127.0.0.1", server.port) as c:
+            c.submit(
+                name="eq", query=query, capacity=CAP, window_edges=W, batch=B
+            )
+            c.push_edges("eq", s, d, batch=B, capacity=CAP, bdv=bdv)
+            for rec in c.iter_results("eq", deadline_s=240):
+                leaves.append([np.asarray(x) for x in rec])
+            status = c.status()["server"]
+    return leaves, status
+
+
+def test_pool_vs_python_oracle_bit_identical_server_run():
+    """The acceptance oracle: GELLY_DECODE_WORKERS=0 (pure Python) and a
+    live pool produce bit-identical emissions for the same stream."""
+    want, st0 = _run_server_stream(0)
+    got, st2 = _run_server_stream(2)
+    assert st0["decode_workers"] == 0 and st0["decode"] is None
+    assert st2["decode_workers"] == 2
+    if HAVE_NATIVE:
+        assert st2["decode"]["native"] > 0
+        assert st2["decode"]["fallback"] == 0
+    assert len(want) == len(got) and len(want) == N // W
+    for a, b in zip(want, got):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+
+
+def test_pool_refusals_survive_connection_and_match_python_path():
+    """Same malformed pushes against a pooled and an oracle server: same
+    refusal code AND message, connection alive afterwards."""
+    s_ok, d_ok = _graph(7)
+
+    def collect(workers):
+        rows = []
+        with JobManager(RuntimeConfig()) as jm, StreamServer(
+            jm, ServerConfig(decode_workers=workers)
+        ) as server:
+            with GellyClient("127.0.0.1", server.port) as c:
+                c.submit(
+                    name="j", query="cc", capacity=CAP, window_edges=W,
+                    batch=B,
+                )
+                bad = [
+                    ("wire", np.zeros(7, np.uint8)),
+                    ("wire", np.full(2 * B * 2, 0xFF, np.uint8)),
+                    ("bdv", np.zeros(16, np.uint8)),
+                    (
+                        "bdv",
+                        np.full(wire.bdv_max_nbytes(B) - 8, 0xFF, np.uint8),
+                    ),
+                ]
+                for kind, buf in bad:
+                    with pytest.raises(ServerRefused) as e:
+                        c.push_wire("j", buf, kind=kind)
+                    rows.append((e.value.code, str(e.value)))
+                # the connection survived every refusal: stream the job out
+                c.push_edges("j", s_ok, d_ok, batch=B, capacity=CAP)
+                n_recs = len(list(c.iter_results("j", deadline_s=240)))
+        return rows, n_recs
+
+    want, n0 = collect(0)
+    got, n2 = collect(2)
+    assert want == got
+    assert n0 == n2 == N // W
+    assert all(code == "bad-wire" for code, _m in want)
+
+
+def test_quiesced_refusal_precedes_decode_on_pooled_path():
+    """A draining source refuses ``quiesced`` — not ``bad-wire`` — even
+    for a malformed buffer, matching push_wire's guard order."""
+    from gelly_streaming_tpu.io.sources import NetworkEdgeSource
+
+    cfg = StreamConfig(
+        vertex_capacity=CAP, batch_size=B, ingest_window_edges=W
+    )
+    src = NetworkEdgeSource(cfg, B)
+    src.quiesce()
+    with pytest.raises(Exception, match="draining"):
+        src.check_open()
+
+
+# ---------------------------------------------------------------------------
+# soak: multi-client, non-idempotent counts, 0 recompiles, arena recycling
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout_cap(600)
+def test_multi_client_soak_exact_counts_zero_recompiles():
+    from gelly_streaming_tpu.core import compile_cache
+
+    clients = 4
+    datasets = [_graph(100 + i) for i in range(clients)]
+    # warm the executables so the soak run itself must compile nothing
+    _run_server_stream(2, seed=100, bdv=False, query="edges")
+    compile_cache.reset_stats()
+
+    errors = []
+    counts = {}
+    with JobManager(RuntimeConfig(max_jobs=8)) as jm, StreamServer(
+        jm, ServerConfig(decode_workers=2)
+    ) as server:
+
+        def run(i):
+            try:
+                s, d = datasets[i]
+                with GellyClient("127.0.0.1", server.port) as c:
+                    c.submit(
+                        name=f"soak-{i}",
+                        query="edges",
+                        capacity=CAP,
+                        window_edges=W,
+                        batch=B,
+                    )
+                    c.push_edges(
+                        f"soak-{i}", s, d, batch=B, capacity=CAP, bdv=True
+                    )
+                    vals = [
+                        int(np.asarray(rec[0]))
+                        for rec in c.iter_results(f"soak-{i}", deadline_s=240)
+                    ]
+                    counts[i] = vals
+            except BaseException as e:  # surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        pool = server._decode_pool
+        stats = pool.stats()
+        free_arenas = sum(len(v) for v in pool._arenas._free.values())
+    if errors:
+        raise errors[0]
+    # exact non-idempotent counts: the running edge-count fold saw every
+    # window exactly once, per client
+    serial = [(k + 1) * W for k in range(N // W)]
+    for i in range(clients):
+        assert counts[i] == serial, f"client {i}: {counts[i]}"
+    assert compile_cache.stats()["recompiles"] == 0
+    assert compile_cache.stats()["compiles"] == 0
+    # every pushed batch went through the pool, and the arenas came back
+    assert stats["native" if HAVE_NATIVE else "fallback"] >= clients * (
+        N // B
+    )
+    assert free_arenas > 0  # recycling actually happened
+
+
+def test_resolve_decode_workers_contract(monkeypatch):
+    monkeypatch.delenv("GELLY_DECODE_WORKERS", raising=False)
+    assert resolve_decode_workers(0) == 0
+    assert resolve_decode_workers(3) == 3
+    from gelly_streaming_tpu.runtime.decode_pool import DEFAULT_DECODE_WORKERS
+
+    assert resolve_decode_workers(-1) == DEFAULT_DECODE_WORKERS
+    monkeypatch.setenv("GELLY_DECODE_WORKERS", "5")
+    assert resolve_decode_workers(-1) == 5
+    assert resolve_decode_workers(1) == 1  # config beats env
+    monkeypatch.setenv("GELLY_DECODE_WORKERS", "lots")
+    with pytest.raises(ValueError, match="GELLY_DECODE_WORKERS"):
+        resolve_decode_workers(-1)
+
+
+def test_decoded_batches_copy_out_before_arena_release():
+    """The donation fence: after the factory yields a batch, mutating the
+    (recycled) arena must not change the batch the consumer holds."""
+    from gelly_streaming_tpu.core.types import EdgeBatch
+    from gelly_streaming_tpu.io.sources import NetworkEdgeSource
+
+    cfg = StreamConfig(vertex_capacity=CAP, batch_size=B)
+    src = NetworkEdgeSource(cfg, B)
+    arena = np.zeros((2, B), np.int32)
+    arena[0, :] = np.arange(B)
+    arena[1, :] = np.arange(B) + 1
+    fired = []
+    src.push_decoded(
+        arena[0], arena[1], release=lambda: fired.append(True)
+    )
+    src.close()
+    batches = list(src._factory())
+    assert len(batches) == 1 and fired == [True]
+    arena[:] = -1  # "recycled" by a later decode
+    assert np.array_equal(np.asarray(batches[0].src), np.arange(B))
+    assert np.array_equal(np.asarray(batches[0].dst), np.arange(B) + 1)

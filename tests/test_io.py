@@ -132,15 +132,22 @@ def test_checkpoint_structure_mismatch(tmp_path):
         load_state(path, {"a": jnp.zeros((8,))})
 
 
-def test_native_src_matches_canonical_source():
-    """The wheel ships gelly_streaming_tpu/native_src/edge_parser.cpp as a real
-    file (not a symlink — symlinks break on checkouts without symlink support,
-    silently degrading ingest to the numpy fallback).  Keep it byte-identical
-    to the canonical native/edge_parser.cpp."""
+def test_native_src_is_canonical_real_file():
+    """The wheel ships gelly_streaming_tpu/native_src/edge_parser.cpp as a
+    real file (not a symlink — symlinks break on checkouts without symlink
+    support, silently degrading ingest to the numpy fallback).  It is the
+    CANONICAL source (ISSUE 14 single-sourcing); the repo-layout
+    native/edge_parser.cpp is a one-include reference stub, pinned in
+    detail by tests/test_native_source_sync.py."""
     import pathlib
 
     pkg = pathlib.Path(__file__).resolve().parent.parent
     shipped = pkg / "gelly_streaming_tpu" / "native_src" / "edge_parser.cpp"
-    canonical = pkg / "native" / "edge_parser.cpp"
     assert not shipped.is_symlink()
-    assert shipped.read_bytes() == canonical.read_bytes()
+    body = shipped.read_text()
+    assert "extern \"C\"" in body  # the code-carrying copy
+    from gelly_streaming_tpu.utils import native as native_mod
+
+    assert native_mod.stub_is_reference_only(
+        str(pkg / "native" / "edge_parser.cpp")
+    )

@@ -1,0 +1,220 @@
+"""Native build gate (ISSUE 14): a fresh compile of the canonical C++
+source must succeed and its entry points must match their numpy twins.
+
+Without this gate, a ``.cpp`` edit that breaks the build (or silently
+diverges from a twin) would just drop the whole tree to the numpy
+fallback — every native-path test "passes" while the fast path is gone.
+Here the library is compiled FRESH into a tmpdir (no sharing with the
+mtime-cached build the rest of the suite uses), loaded, and run through
+encoder / sorter / reader self-checks against the pure-numpy oracles.
+Skips cleanly when the image has no C++ toolchain.
+"""
+
+import ctypes
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.io import wire
+
+pytestmark = pytest.mark.timeout_cap(240)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CANONICAL = os.path.join(
+    ROOT, "gelly_streaming_tpu", "native_src", "edge_parser.cpp"
+)
+
+
+@pytest.fixture(scope="module")
+def fresh_lib(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain in this image")
+    so = str(tmp_path_factory.mktemp("native_gate") / "libgelly_gate.so")
+    # the exact flags utils/native.py builds with
+    proc = subprocess.run(
+        [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            CANONICAL, "-o", so,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, (
+        "canonical native source failed to compile:\n" + proc.stderr
+    )
+    return ctypes.CDLL(so)
+
+
+def _i32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def test_fresh_build_packers_match_numpy_twins(fresh_lib):
+    lib = fresh_lib
+    lib.pack_edges.restype = ctypes.c_int64
+    lib.pack_edges40.restype = ctypes.c_int64
+    lib.encode_edges_bdv.restype = ctypes.c_int64
+    rng = np.random.default_rng(1)
+    n = 513
+    for cap, width in [(1 << 16, 2), (1 << 24, 3), (1 << 26, 4)]:
+        s = rng.integers(0, cap, n).astype(np.int32)
+        d = rng.integers(0, cap, n).astype(np.int32)
+        out = np.empty(2 * n * width, np.uint8)
+        wrote = lib.pack_edges(
+            _i32p(s), _i32p(d), ctypes.c_int64(n), ctypes.c_int32(width),
+            _u8p(out),
+        )
+        assert wrote == out.nbytes
+        # numpy twin: the low `width` little-endian bytes per id, blocks
+        twin = np.concatenate(
+            [
+                np.ascontiguousarray(
+                    x.view(np.uint8).reshape(-1, 4)[:, :width]
+                ).reshape(-1)
+                for x in (s, d)
+            ]
+        )
+        assert np.array_equal(out, twin), f"width {width} pack drift"
+    # pair40
+    cap = 1 << 20
+    s = rng.integers(0, cap, n).astype(np.int32)
+    d = rng.integers(0, cap, n).astype(np.int32)
+    out = np.empty(5 * n, np.uint8)
+    assert lib.pack_edges40(
+        _i32p(s), _i32p(d), ctypes.c_int64(n), _u8p(out)
+    ) == out.nbytes
+    w = (s.astype(np.uint64) & 0xFFFFF) | (
+        (d.astype(np.uint64) & 0xFFFFF) << np.uint64(20)
+    )
+    twin = np.ascontiguousarray(
+        w.view(np.uint8).reshape(-1, 8)[:, :5]
+    ).reshape(-1)
+    assert np.array_equal(out, twin), "pair40 pack drift"
+    # BDV encoder over a sorted batch
+    order = np.lexsort((s, d))
+    s2, d2 = s[order], d[order]
+    out = np.empty(wire.bdv_max_nbytes(n) + 8, np.uint8)
+    wrote = lib.encode_edges_bdv(
+        _i32p(s2), _i32p(d2), ctypes.c_int64(n), _u8p(out),
+        ctypes.c_int64(out.nbytes),
+    )
+    assert wrote > 0
+    twin = wire._encode_bdv_np(s2, d2)
+    assert np.array_equal(out[:wrote], twin), "BDV encoder drift"
+
+
+def test_fresh_build_sorter_matches_lexsort(fresh_lib):
+    lib = fresh_lib
+    lib.sort_edges_dst_src.restype = ctypes.c_int64
+    rng = np.random.default_rng(2)
+    for cap in (1 << 10, 1 << 23):  # counting-sort and radix regimes
+        n = 4096
+        s = rng.integers(0, cap, n).astype(np.int32)
+        d = rng.integers(0, cap, n).astype(np.int32)
+        out_s = np.empty(n, np.int32)
+        out_d = np.empty(n, np.int32)
+        assert (
+            lib.sort_edges_dst_src(
+                _i32p(s), _i32p(d), ctypes.c_int64(n), ctypes.c_int32(cap),
+                _i32p(out_s), _i32p(out_d),
+            )
+            == n
+        )
+        order = np.lexsort((s, d))
+        assert np.array_equal(out_s, s[order])
+        assert np.array_equal(out_d, d[order])
+
+
+def test_fresh_build_reader_and_probe_self_check(fresh_lib):
+    lib = fresh_lib
+    lib.decode_wire_into.restype = ctypes.c_int64
+    lib.gly1_probe_prefix.restype = ctypes.c_int32
+    lib.gly1_probe_prefix.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    # probe taxonomy
+    hl, pl = ctypes.c_int64(0), ctypes.c_int64(0)
+    ok = struct.pack(">4sII", b"GLY1", 7, 9)
+    assert lib.gly1_probe_prefix(
+        ok, 1 << 16, 1 << 26, ctypes.byref(hl), ctypes.byref(pl)
+    ) == 0
+    assert (hl.value, pl.value) == (7, 9)
+    bad = struct.pack(">4sII", b"XXXX", 7, 9)
+    assert lib.gly1_probe_prefix(
+        bad, 1 << 16, 1 << 26, ctypes.byref(hl), ctypes.byref(pl)
+    ) == -1
+    # decode round trips vs the wire twins, every push encoding
+    rng = np.random.default_rng(3)
+    n = 511
+    for cap, width, code in [
+        (1 << 14, 2, 2),
+        (1 << 19, wire.PAIR40, 5),
+        (1 << 22, 3, 3),
+        (1 << 26, 4, 4),
+    ]:
+        s = rng.integers(0, cap, n).astype(np.int32)
+        d = rng.integers(0, cap, n).astype(np.int32)
+        buf = wire.pack_edges(s, d, width)
+        out_s = np.empty(n, np.int32)
+        out_d = np.empty(n, np.int32)
+        rc = lib.decode_wire_into(
+            _u8p(buf), ctypes.c_int64(buf.nbytes), ctypes.c_int64(n),
+            ctypes.c_int32(code), ctypes.c_int32(cap), ctypes.c_int32(0),
+            _i32p(out_s), _i32p(out_d),
+        )
+        assert rc == n, (width, rc)
+        assert np.array_equal(out_s, s) and np.array_equal(out_d, d)
+    # BDV: decode must invert the encoder (sorted multiset) and refuse
+    # an id past capacity with the range code
+    cap = 1 << 14
+    s = rng.integers(0, cap, n).astype(np.int32)
+    d = rng.integers(0, cap, n).astype(np.int32)
+    buf = wire.pack_edges_bdv(s, d, cap)
+    out_s = np.empty(n, np.int32)
+    out_d = np.empty(n, np.int32)
+    rc = lib.decode_wire_into(
+        _u8p(buf), ctypes.c_int64(buf.nbytes), ctypes.c_int64(n),
+        ctypes.c_int32(6), ctypes.c_int32(cap), ctypes.c_int32(0),
+        _i32p(out_s), _i32p(out_d),
+    )
+    assert rc == n
+    ws, wd = wire.unpack_edges_bdv_host(buf, n)
+    assert np.array_equal(out_s, ws) and np.array_equal(out_d, wd)
+    rc = lib.decode_wire_into(
+        _u8p(buf), ctypes.c_int64(buf.nbytes), ctypes.c_int64(n),
+        ctypes.c_int32(6), ctypes.c_int32(8), ctypes.c_int32(0),
+        _i32p(out_s), _i32p(out_d),
+    )
+    assert rc == -2  # id-range refusal
+
+
+def test_fresh_build_binning_decode_matches_two_pass(fresh_lib):
+    """sort=1 (decode + bin in one native pass) equals decode-then-
+    sort_edges_binned — the same-pass binning claim, pinned."""
+    lib = fresh_lib
+    lib.decode_wire_into.restype = ctypes.c_int64
+    rng = np.random.default_rng(4)
+    cap, n = 1 << 16, 1024
+    s = rng.integers(0, cap, n).astype(np.int32)
+    d = rng.integers(0, cap, n).astype(np.int32)
+    buf = wire.pack_edges(s, d, 2)
+    out_s = np.empty(n, np.int32)
+    out_d = np.empty(n, np.int32)
+    rc = lib.decode_wire_into(
+        _u8p(buf), ctypes.c_int64(buf.nbytes), ctypes.c_int64(n),
+        ctypes.c_int32(2), ctypes.c_int32(cap), ctypes.c_int32(1),
+        _i32p(out_s), _i32p(out_d),
+    )
+    assert rc == n
+    es, ed = wire.sort_edges_binned(s, d, cap)
+    assert np.array_equal(out_s, es) and np.array_equal(out_d, ed)
