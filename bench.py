@@ -1802,6 +1802,9 @@ def main():
                     "core",
                     "io",
                     "library",
+                    # the C++ byte path rides the same attestation: the
+                    # nativecheck passes (#10-#13) pick it up from here
+                    "native_src",
                     "parallel",
                     "runtime",
                     "utils",

@@ -62,11 +62,31 @@ Passes #6-#8 are PROJECT passes: they see every scanned file at once
 modules; on a single-file ``analyze_source``/``analyze_file`` call they
 run with that file as the whole project.
 
+  #10 ``native-leak``    NATIVELEAK — a ``malloc`` in a C++ function with a
+                         return path that neither frees it nor is covered
+                         by a ``// owns: caller`` annotation.
+  #11 ``native-bound``   NATIVEBOUND — indexing/``memcpy``/pointer
+                         arithmetic on a ``// untrusted:``-tagged C++
+                         parameter without a dominating bounds comparison
+                         against its declared length.
+  #12 ``native-ovfl``    NATIVEOVFL — size arithmetic fed to
+                         ``malloc``/``calloc``/``memcpy`` without
+                         ``(size_t)`` widening on the left operand.
+  #13 ``native-abi``     NATIVEABI — every ``extern "C"`` export must match
+                         the declared ctypes signature table in
+                         ``utils/native.py`` by name/arity/argument width.
+
+Passes #10-#13 are NATIVE passes (``languages = ("cpp",)``): they run over
+``native_src/*.cpp`` (the untrusted byte path behind the serving plane's
+C ABI) with the same Finding/suppression/baseline machinery; the Python
+passes skip C++ files and vice versa.  See ``nativecheck.py``.
+
 Finding format: ``file:line: [PASS/CODE] message``.
 
 Suppression grammar: a ``# graft: disable=CODE[,CODE...]`` comment on the
 finding's line (or standalone on the line directly above) suppresses those
-codes there; free-form justification may follow the code list.  Baseline:
+codes there; free-form justification may follow the code list.  C++ files
+use the same grammar behind ``//`` (``// graft: disable=CODE``).  Baseline:
 findings whose (file, code, message) fingerprint is grandfathered in the
 JSON baseline (``--write-baseline`` emits one) are reported separately and
 do not fail the run — NEW findings with the same fingerprint beyond the
@@ -108,7 +128,32 @@ class Finding:
         return (self.path.replace(os.sep, "/"), self.code, self.message)
 
 
-_DISABLE_RE = re.compile(r"#\s*graft:\s*disable=([A-Za-z0-9_*]+(?:\s*,\s*[A-Za-z0-9_*]+)*)")
+# one suppression regex per language: the C++ grammar (`// graft:`) must
+# not fire inside an ordinary Python '#' comment that merely MENTIONS it
+# (and vice versa), or prose about one grammar silences findings in the
+# other
+_DISABLE_RE_PY = re.compile(
+    r"#\s*graft:\s*disable=([A-Za-z0-9_*]+(?:\s*,\s*[A-Za-z0-9_*]+)*)"
+)
+_DISABLE_RE_CPP = re.compile(
+    r"//\s*graft:\s*disable=([A-Za-z0-9_*]+(?:\s*,\s*[A-Za-z0-9_*]+)*)"
+)
+
+#: extensions routed to the C++ (``cpp``) pass family instead of the
+#: Python AST passes
+CPP_EXTENSIONS = (".cpp", ".cc", ".cxx", ".h", ".hpp")
+
+
+def _extract_cpp_comments(path: str, text: str) -> Dict[int, str]:
+    """lineno -> comment text for a C++ source, with string/char literals
+    skipped so marker text inside them cannot spoof an annotation — the
+    same guarantee ``tokenize`` gives the Python side.  ONE cached walk
+    (nativecheck's lexer) serves both this map and the native passes'
+    token stream: the comment extractor and the code lexer can never
+    disagree about what is inside a literal, and a file is lexed once."""
+    from gelly_streaming_tpu.analysis import nativecheck
+
+    return nativecheck.cpp_comments(path, text)
 
 
 class SourceFile:
@@ -127,15 +172,35 @@ class SourceFile:
         self.lines = text.splitlines()
         self.tree: Optional[ast.AST] = None
         self.parse_error: Optional[str] = None
-        #: lineno -> comment text (with leading '#'), one comment per line max
+        #: 'python' or 'cpp' — decides which pass family runs and which
+        #: comment grammar ('#' vs '//') is extracted
+        self.language = (
+            "cpp"
+            if self.display_path.endswith(CPP_EXTENSIONS)
+            or self.path.endswith(CPP_EXTENSIONS)
+            else "python"
+        )
+        #: lineno -> comment text (with leading '#' / '//'), one per line max
         self.comments: Dict[int, str] = {}
         #: lineno -> set of codes disabled on that line ('*' disables all)
         self.suppressions: Dict[int, Set[str]] = {}
+        if self.language == "cpp":
+            # no Python parse: C++ files carry no AST; the native passes
+            # lex the text themselves, and parse_error stays None so the
+            # framework never emits a bogus PARSE finding for them.  The
+            # comment map is shared read-only with the pass cache.
+            self.comments = _extract_cpp_comments(self.path, text)
+            for lineno, comment in self.comments.items():
+                m = _DISABLE_RE_CPP.search(comment)
+                if m:
+                    codes = {c.strip() for c in m.group(1).split(",")}
+                    self.suppressions.setdefault(lineno, set()).update(codes)
+            return
         try:
             for tok in tokenize.generate_tokens(io.StringIO(text).readline):
                 if tok.type == tokenize.COMMENT:
                     self.comments[tok.start[0]] = tok.string
-                    m = _DISABLE_RE.search(tok.string)
+                    m = _DISABLE_RE_PY.search(tok.string)
                     if m:
                         codes = {c.strip() for c in m.group(1).split(",")}
                         self.suppressions.setdefault(tok.start[0], set()).update(codes)
@@ -163,10 +228,14 @@ class SourceFile:
     def suppressed(self, lineno: int, code: str) -> bool:
         """Suppression applies on the finding's own line or as a standalone
         comment on the line directly above it."""
+        marker = "//" if self.language == "cpp" else "#"
         for at in (lineno, lineno - 1):
             codes = self.suppressions.get(at)
             if codes and (code in codes or "*" in codes):
-                if at == lineno - 1 and self.lines[at - 1].split("#")[0].strip():
+                if (
+                    at == lineno - 1
+                    and self.lines[at - 1].split(marker)[0].strip()
+                ):
                     continue  # the line above holds code: its trailing
                     # comment governs that line, not this one
                 return True
@@ -185,6 +254,9 @@ class Pass:
     codes: Tuple[str, ...] = ()
     #: one-line description for --list-passes
     description: str = ""
+    #: source languages the pass understands; the framework only hands it
+    #: matching SourceFiles (the nativecheck passes set ("cpp",))
+    languages: Tuple[str, ...] = ("python",)
 
     def run(self, sf: SourceFile) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -229,6 +301,7 @@ def load_passes() -> Dict[str, Pass]:
     from gelly_streaming_tpu.analysis import collectives  # noqa: F401
     from gelly_streaming_tpu.analysis import concurrency  # noqa: F401
     from gelly_streaming_tpu.analysis import testdiscipline  # noqa: F401
+    from gelly_streaming_tpu.analysis import nativecheck  # noqa: F401
 
     return dict(_REGISTRY)
 
@@ -265,6 +338,8 @@ def analyze_source(
         return [sf.finding(1, "analysis", "PARSE", sf.parse_error)]
     out: List[Finding] = []
     for p in passes:
+        if sf.language not in p.languages:
+            continue
         out.extend(_filter_suppressed(p.run(sf), sf, keep_suppressed))
     out.sort(key=lambda f: (f.path, f.line, f.code))
     return out
@@ -288,15 +363,28 @@ def analyze_file(
         )
 
 
-def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+def _iter_files(paths: Iterable[str], exts: Tuple[str, ...]) -> Iterable[str]:
     for path in paths:
         if os.path.isdir(path):
             for dirpath, _dirs, files in os.walk(path):
                 for name in sorted(files):
-                    if name.endswith(".py"):
+                    if name.endswith(exts):
                         yield os.path.join(dirpath, name)
         else:
             yield path
+
+
+def iter_source_files(paths: Iterable[str]) -> Iterable[str]:
+    """Scannable files under ``paths``: ``.py`` plus the C++ extensions
+    the native passes understand (``native_src/`` rides the default scan)."""
+    return _iter_files(paths, (".py",) + CPP_EXTENSIONS)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    """``.py`` files only (explicit file paths pass through) — the
+    per-language walker callers like ``hot_loop.check_paths`` consume;
+    the analyzer's own scan uses ``iter_source_files``."""
+    return _iter_files(paths, (".py",))
 
 
 def _display_for(path: str, root: Optional[str]) -> str:
@@ -336,7 +424,7 @@ def analyze_paths(
         passes = list(load_passes().values())
     file_passes = [p for p in passes if not isinstance(p, ProjectPass)]
     project_passes = [p for p in passes if isinstance(p, ProjectPass)]
-    files = list(iter_python_files(paths))
+    files = list(iter_source_files(paths))
     findings: List[Finding] = []
     parsed: Optional[List[SourceFile]] = None
     if jobs > 1 and len(files) > 1:
@@ -365,6 +453,8 @@ def analyze_paths(
                 )
                 continue
             for p in file_passes:
+                if sf.language not in p.languages:
+                    continue
                 findings.extend(
                     _filter_suppressed(p.run(sf), sf, keep_suppressed)
                 )

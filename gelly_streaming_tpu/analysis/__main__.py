@@ -44,12 +44,22 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--paths",
         nargs="+",
-        default=["core", "io", "library", "ops", "parallel", "runtime", "utils"],
+        default=[
+            "core",
+            "io",
+            "library",
+            "native_src",
+            "ops",
+            "parallel",
+            "runtime",
+            "utils",
+        ],
         help="files/directories to scan; bare names resolve inside the "
-        "gelly_streaming_tpu package (default: core io library ops "
-        "parallel runtime utils — utils hosts the tracing flight "
-        "recorder and metrics registries whose lock discipline the "
-        "lock pass pins)",
+        "gelly_streaming_tpu package (default: core io library "
+        "native_src ops parallel runtime utils — utils hosts the "
+        "tracing flight recorder and metrics registries whose lock "
+        "discipline the lock pass pins, native_src the C++ byte path "
+        "the nativecheck passes lint)",
     )
     parser.add_argument(
         "--select",

@@ -1,0 +1,1103 @@
+"""Passes #10-#13 — ``nativecheck``: graftcheck over the C++ byte path.
+
+PR 12 moved the serving hot path into ``native_src/edge_parser.cpp``:
+hand-managed C++ that parses ATTACKER-CONTROLLED network bytes
+(``gly1_probe_prefix``, ``decode_wire_into``) behind a ctypes C ABI — and
+until this module it sat entirely outside graftcheck, whose other passes
+only see Python AST.  The C++ layer gets the same treatment the Python
+side earned: comment-declared contracts, machine-checked, with the shared
+Finding/suppression/baseline machinery (``// graft: disable=CODE`` is the
+C++ suppression grammar).
+
+No clang dependency — the same pure-stdlib stance as the rest of the
+suite.  A small lexer (preprocessor lines, comments, string/char literals
+stripped) feeds a function-region parser that recovers, per function:
+name, parameters with C types, ``extern "C"`` linkage, and the body token
+stream with line numbers.  Four rule families run over the regions:
+
+  ``native-leak``  NATIVELEAK — a ``malloc``/``calloc``/``realloc`` whose
+      function has a later return path with no ``free`` of the pointer
+      between the allocation and that return.  Returns inside the
+      allocation's own failure guard (``if (!p) return ...``) are exempt
+      (nothing to leak), and ``// owns: caller`` on the allocation line or
+      the function signature transfers the obligation to the caller.
+
+  ``native-bound`` NATIVEBOUND — a parameter tagged ``// untrusted:
+      name[len]`` (on or directly above the signature) is indexed, used in
+      pointer arithmetic, or passed onward without a DOMINATING bounds
+      comparison against its declared length.  ``len`` is either another
+      parameter (every use must be preceded by a comparison involving it)
+      or an integer literal (every index must be a literal below it).
+      ``decode_wire_into`` and ``gly1_probe_prefix`` carry the tags — the
+      socket is the trust boundary, and these are the bytes' first stop.
+
+  ``native-ovfl``  NATIVEOVFL — size arithmetic fed to ``malloc`` /
+      ``calloc`` / ``memcpy`` / ``memmove`` without ``(size_t)`` widening
+      on the LEFT operand: ``(n + 1) * 4`` evaluates in the narrow/signed
+      type and only then converts, so the overflow happens before the
+      widening — ``((size_t)n + 1) * 4`` is the sanctioned shape.
+      Expressions whose every identifier is a declared ``size_t`` or a
+      file constant (``kCamel`` / ``ALL_CAPS`` / ``constexpr``) are clean.
+
+  ``native-abi``   NATIVEABI — every ``extern "C"`` export must match the
+      declared ctypes signature in ``utils/native.py``'s
+      ``NATIVE_SIGNATURES`` table by name, arity, and argument WIDTH
+      (pointer-to-1-byte vs pointer-to-8-byte, int32 vs int64, int vs
+      float pointee).  Cross-language signature drift is silent memory
+      corruption: ctypes happily truncates or sign-extends and the callee
+      scribbles past the caller's buffer.  The table is parsed from the
+      module's source with ``ast`` — the analyzer never imports it.
+
+Scope limits, deliberate: the leak check is textual-order flow (free
+must appear between the allocation and the return — matching the tree's
+cleanup-before-every-return idiom), not a CFG; the bounds check requires
+a dominating comparison to EXIST, not to be arithmetically sufficient;
+helpers reached by pointer handoff are covered only if themselves tagged.
+The ASan/UBSan fuzz gate (tests/test_native_sanitizers.py) is the dynamic
+complement that catches what these approximations miss.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gelly_streaming_tpu import analysis
+
+# ---------------------------------------------------------------------------
+# lexer
+
+
+class Tok:
+    __slots__ = ("kind", "text", "line")
+
+    def __init__(self, kind: str, text: str, line: int):
+        self.kind = kind  # 'id' | 'num' | 'str' | 'char' | 'punct'
+        self.text = text
+        self.line = line
+
+    def __repr__(self):  # pragma: no cover — debug aid
+        return f"Tok({self.kind},{self.text!r},{self.line})"
+
+
+_ID_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+_NUM_RE = re.compile(r"(?:0[xX][0-9a-fA-F]+|\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)[uUlLfF]*")
+# longest-first so '<<' lexes as one shift token, not two comparisons
+_PUNCTS = (
+    "<<=", ">>=", "->*", "...", "::", "->", "<<", ">>", "<=", ">=", "==",
+    "!=", "&&", "||", "++", "--", "+=", "-=", "*=", "/=", "%=", "&=", "|=",
+    "^=",
+)
+
+
+def lex(text: str, comments: Optional[Dict[int, str]] = None) -> List[Tok]:
+    """Tokenize C++ source: comments, preprocessor lines, and the *content*
+    of string/char literals are dropped (literals become single tokens), so
+    marker text inside a string can never look like code.
+
+    When ``comments`` is passed, it is filled lineno -> comment text (each
+    line a ``/* */`` block touches gets its part; multiple comments on a
+    line join) — the SAME walk feeds the framework's suppression/
+    annotation map (``analysis._extract_cpp_comments``) and the pass token
+    stream, so the two can never disagree about literal boundaries."""
+
+    def note_comment(at: int, part: str) -> None:
+        if comments is not None and part.strip():
+            prior = comments.get(at, "")
+            comments[at] = (prior + " " if prior else "") + part
+
+    toks: List[Tok] = []
+    line = 1
+    i = 0
+    n = len(text)
+    at_line_start = True
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            at_line_start = True
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if c == "#" and at_line_start:
+            # preprocessor directive: consume to end of line (backslash
+            # continuations extend it).  Comments inside the directive
+            # still reach the map, and the directive skip RESUMES after a
+            # block comment — its trailing text is directive text, never
+            # code tokens ('#define K /* bytes */ (1 << 16)' must not leak
+            # '( 1 << 16 )' into the file-scope stream)
+            while i < n and text[i] != "\n":
+                if text[i] == "\\" and i + 1 < n and text[i + 1] == "\n":
+                    line += 1
+                    i += 2
+                    continue
+                if text[i] == "/" and i + 1 < n and text[i + 1] == "/":
+                    j = text.find("\n", i)
+                    if j == -1:
+                        j = n
+                    note_comment(line, text[i:j])
+                    i = j
+                    break  # the line comment runs to the directive's end
+                if text[i] == "/" and i + 1 < n and text[i + 1] == "*":
+                    j = text.find("*/", i + 2)
+                    end = n if j == -1 else j + 2
+                    for off, part in enumerate(text[i:end].split("\n")):
+                        note_comment(line + off, part)
+                    line += text.count("\n", i, end)
+                    i = end
+                    continue  # a block comment is a space mid-directive
+                i += 1
+            continue
+        at_line_start = False
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            if j == -1:
+                j = n
+            note_comment(line, text[i:j])
+            i = j
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            end = n if j == -1 else j + 2
+            for off, part in enumerate(text[i:end].split("\n")):
+                note_comment(line + off, part)
+            line += text.count("\n", i, end)
+            i = end
+            continue
+        if c in "\"'":
+            quote = c
+            j = i + 1
+            while j < n and text[j] != quote:
+                if text[j] == "\\":
+                    j += 1
+                elif text[j] == "\n":
+                    line += 1
+                j += 1
+            toks.append(
+                Tok("str" if quote == '"' else "char", text[i : j + 1], line)
+            )
+            i = j + 1
+            continue
+        m = _ID_RE.match(text, i)
+        if m:
+            toks.append(Tok("id", m.group(0), line))
+            i = m.end()
+            continue
+        m = _NUM_RE.match(text, i)
+        if m:
+            toks.append(Tok("num", m.group(0), line))
+            i = m.end()
+            continue
+        for p in _PUNCTS:
+            if text.startswith(p, i):
+                toks.append(Tok("punct", p, line))
+                i += len(p)
+                break
+        else:
+            toks.append(Tok("punct", c, line))
+            i += 1
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# function-region parser
+
+
+class CppFunction:
+    """One parsed function definition: signature facts + body token slice."""
+
+    def __init__(
+        self,
+        name: str,
+        params: List[Tuple[str, str]],  # (normalized type, param name)
+        ret_type: str,
+        extern_c: bool,
+        sig_line: int,
+        body_open_line: int,
+        body: List[Tok],
+    ):
+        self.name = name
+        self.params = params
+        self.ret_type = ret_type
+        self.extern_c = extern_c
+        self.sig_line = sig_line
+        self.body_open_line = body_open_line
+        self.body = body
+
+    def param_names(self) -> List[str]:
+        return [n for (_t, n) in self.params]
+
+
+_TYPE_KEYWORDS = frozenset(
+    {
+        "const", "unsigned", "signed", "volatile", "struct", "class",
+        "inline", "static", "extern", "constexpr",
+    }
+)
+
+
+def _normalize_type(tokens: Sequence[Tok]) -> str:
+    """``const uint8_t *`` -> ``uint8*``: qualifiers dropped, ``_t``
+    stripped, stars appended — the spelling ``NATIVE_SIGNATURES`` uses."""
+    base: List[str] = []
+    stars = 0
+    for t in tokens:
+        if t.kind == "punct":
+            if t.text == "*":
+                stars += 1
+            continue
+        if t.text in ("const", "volatile", "struct", "class"):
+            continue
+        base.append(t.text)
+    name = " ".join(base)
+    if name.endswith("_t"):
+        name = name[:-2]
+    if name == "unsigned char":
+        name = "uint8"
+    return name + "*" * stars
+
+
+def _split_params(tokens: Sequence[Tok]) -> List[Tuple[str, str]]:
+    """Split a parenthesized parameter token run at top-level commas into
+    (normalized type, name) pairs."""
+    if not tokens or (len(tokens) == 1 and tokens[0].text == "void"):
+        return []
+    groups: List[List[Tok]] = [[]]
+    depth = 0
+    for t in tokens:
+        if t.kind == "punct" and t.text in "([<":
+            depth += 1
+        elif t.kind == "punct" and t.text in ")]>":
+            depth -= 1
+        if t.kind == "punct" and t.text == "," and depth == 0:
+            groups.append([])
+            continue
+        groups[-1].append(t)
+    params: List[Tuple[str, str]] = []
+    for g in groups:
+        if not g:
+            continue
+        # the parameter name is the last identifier; everything before is type
+        name_idx = None
+        for k in range(len(g) - 1, -1, -1):
+            if g[k].kind == "id" and g[k].text not in _TYPE_KEYWORDS:
+                name_idx = k
+                break
+        if name_idx is None or name_idx == 0:
+            params.append((_normalize_type(g), ""))  # unnamed parameter
+        else:
+            params.append((_normalize_type(g[:name_idx]), g[name_idx].text))
+    return params
+
+
+def _match_forward(toks: Sequence[Tok], i: int, open_: str, close: str) -> int:
+    """Index of the token closing the bracket opened at ``i``."""
+    depth = 0
+    for k in range(i, len(toks)):
+        if toks[k].kind == "punct":
+            if toks[k].text == open_:
+                depth += 1
+            elif toks[k].text == close:
+                depth -= 1
+                if depth == 0:
+                    return k
+    return len(toks) - 1
+
+
+def parse_functions(toks: List[Tok]) -> List[CppFunction]:
+    """Recover file-scope (and namespace/extern-block-scope) function
+    definitions.  Struct/class bodies are skipped wholesale; nested lambdas
+    stay part of their enclosing function's body."""
+    funcs: List[CppFunction] = []
+    i = 0
+    n = len(toks)
+    extern_depth = 0  # inside `extern "C" { ... }`
+    scope_stack: List[str] = []  # 'extern' | 'namespace'
+    stmt_start = 0  # token index where the current declaration began
+    while i < n:
+        t = toks[i]
+        if t.kind == "punct" and t.text in (";",):
+            stmt_start = i + 1
+            i += 1
+            continue
+        if t.kind == "id" and t.text == "extern" and i + 1 < n and toks[i + 1].kind == "str":
+            if i + 2 < n and toks[i + 2].kind == "punct" and toks[i + 2].text == "{":
+                scope_stack.append("extern")
+                extern_depth += 1
+                i += 3
+                stmt_start = i
+                continue
+            # single-declaration `extern "C" ret name(...)` — fall through;
+            # the prefix scan below sees the extern + "C" tokens
+            i += 2
+            continue
+        if t.kind == "id" and t.text == "namespace":
+            # `namespace X {` or anonymous `namespace {`
+            j = i + 1
+            while j < n and not (toks[j].kind == "punct" and toks[j].text in "{;"):
+                j += 1
+            if j < n and toks[j].text == "{":
+                scope_stack.append("namespace")
+                i = j + 1
+                stmt_start = i
+                continue
+            i = j + 1
+            continue
+        if t.kind == "id" and t.text in ("struct", "class", "enum", "union"):
+            # skip to the matching close brace (or ';' for a forward decl)
+            j = i + 1
+            while j < n and not (toks[j].kind == "punct" and toks[j].text in "{;"):
+                j += 1
+            if j < n and toks[j].text == "{":
+                j = _match_forward(toks, j, "{", "}")
+            i = j + 1
+            stmt_start = i
+            continue
+        if t.kind == "punct" and t.text == "}":
+            if scope_stack:
+                if scope_stack.pop() == "extern":
+                    extern_depth -= 1
+            i += 1
+            stmt_start = i
+            continue
+        if t.kind == "punct" and t.text == "(":
+            close = _match_forward(toks, i, "(", ")")
+            after = close + 1
+            if (
+                after < n
+                and toks[after].kind == "punct"
+                and toks[after].text == "{"
+                and i > stmt_start
+                and toks[i - 1].kind == "id"
+            ):
+                # `name ( params ) {` at declaration scope: a definition
+                name_tok = toks[i - 1]
+                prefix = toks[stmt_start : i - 1]
+                prefix_texts = [p.text for p in prefix]
+                is_extern = extern_depth > 0 or (
+                    "extern" in prefix_texts
+                    and any(p.kind == "str" for p in prefix)
+                )
+                is_static = "static" in prefix_texts or any(
+                    s == "namespace" for s in scope_stack
+                )
+                ret = _normalize_type(
+                    [
+                        p
+                        for p in prefix
+                        if p.kind != "str"
+                        and p.text not in ("extern", "inline", "static", "constexpr")
+                    ]
+                )
+                body_close = _match_forward(toks, after, "{", "}")
+                funcs.append(
+                    CppFunction(
+                        name_tok.text,
+                        _split_params(toks[i + 1 : close]),
+                        ret,
+                        bool(is_extern) and not is_static,
+                        name_tok.line,
+                        toks[after].line,
+                        toks[after + 1 : body_close],
+                    )
+                )
+                i = body_close + 1
+                stmt_start = i
+                continue
+            # a call / macro-ish use at declaration scope: skip past it
+            i = close + 1
+            continue
+        i += 1
+    return funcs
+
+
+# parsed-file memo: the framework's comment map AND all four passes share
+# ONE lex+parse per (path, text) — entries are (functions, file-constant
+# names, comment map)
+_PARSE_CACHE: Dict[
+    Tuple[str, int, int],
+    Tuple[List[CppFunction], frozenset, Dict[int, str]],
+] = {}
+
+
+def _parsed_text(
+    path: str, text: str
+) -> Tuple[List[CppFunction], frozenset, Dict[int, str]]:
+    key = (path, len(text), hash(text))
+    entry = _PARSE_CACHE.get(key)
+    if entry is None:
+        if len(_PARSE_CACHE) > 64:  # the suite scans a handful of files
+            _PARSE_CACHE.clear()
+        comments: Dict[int, str] = {}
+        toks = lex(text, comments=comments)
+        entry = (parse_functions(toks), _constexpr_names(toks), comments)
+        _PARSE_CACHE[key] = entry
+    return entry
+
+
+def cpp_comments(path: str, text: str) -> Dict[int, str]:
+    """The comment map ``analysis.SourceFile`` consumes for C++ files —
+    produced by the SAME cached walk that feeds the passes, so a file is
+    lexed exactly once per scan.  Treat the returned dict as read-only."""
+    return _parsed_text(path, text)[2]
+
+
+def functions_for(sf: analysis.SourceFile) -> List[CppFunction]:
+    return _parsed_text(sf.path, sf.text)[0]
+
+
+def constants_for(sf: analysis.SourceFile) -> frozenset:
+    """Names declared ``const``/``constexpr`` with a literal initializer
+    anywhere in the file — exempt from NATIVEOVFL's suspect-identifier
+    collection."""
+    return _parsed_text(sf.path, sf.text)[1]
+
+
+# ---------------------------------------------------------------------------
+# body-walk helpers shared by the rule families
+
+
+def _guarded_returns(body: List[Tok]) -> List[Tuple[int, int, List[str]]]:
+    """(token index, line, enclosing-condition texts) for each ``return``.
+
+    Conditions are tracked through a brace-scoped stack plus the
+    single-statement ``if (cond) return x;`` form, compacted to
+    whitespace-free strings for the null-guard test."""
+    out: List[Tuple[int, int, List[str]]] = []
+    stack: List[Optional[str]] = []
+    pending: Optional[str] = None  # condition awaiting its statement/brace
+    single_stmt: Optional[str] = None  # condition governing until next ';'
+    i = 0
+    n = len(body)
+    while i < n:
+        t = body[i]
+        if t.kind == "id" and t.text in ("if", "while", "for", "switch"):
+            if i + 1 < n and body[i + 1].kind == "punct" and body[i + 1].text == "(":
+                close = _match_forward(body, i + 1, "(", ")")
+                cond = "".join(x.text for x in body[i + 2 : close])
+                pending = cond if t.text == "if" else None
+                i = close + 1
+                continue
+        if t.kind == "punct" and t.text == "{":
+            stack.append(pending)
+            pending = None
+            i += 1
+            continue
+        if t.kind == "punct" and t.text == "}":
+            if stack:
+                stack.pop()
+            i += 1
+            continue
+        if pending is not None:
+            # brace-less governed statement: active until the next ';'
+            single_stmt = pending
+            pending = None
+        if t.kind == "punct" and t.text == ";":
+            single_stmt = None
+            i += 1
+            continue
+        if t.kind == "id" and t.text == "return":
+            conds = [c for c in stack if c]
+            if single_stmt:
+                conds.append(single_stmt)
+            out.append((i, t.line, conds))
+        i += 1
+    return out
+
+
+def _split_top_level(expr: str, sep: str) -> List[str]:
+    """Split a compacted condition at top-level (paren-depth-0) ``sep``."""
+    parts: List[str] = []
+    depth = 0
+    cur: List[str] = []
+    i = 0
+    while i < len(expr):
+        c = expr[i]
+        if c in "([":
+            depth += 1
+        elif c in ")]":
+            depth -= 1
+        if depth == 0 and expr.startswith(sep, i):
+            parts.append("".join(cur))
+            cur = []
+            i += len(sep)
+            continue
+        cur.append(c)
+        i += 1
+    parts.append("".join(cur))
+    return parts
+
+
+def _null_guarded(conds: List[str], var: str) -> bool:
+    """True when an enclosing condition GUARANTEES this pointer is null on
+    the return path — the allocation's own failure guard, where returning
+    leaks nothing.  The condition must pin var null in every way it can be
+    true: each top-level ``||`` disjunct needs an ``&&``-conjunct that is
+    var's null test (``if (!p || n > 100) return`` does NOT exempt p — the
+    n-branch returns with p live).  Matching is identifier-boundary-exact
+    on the compacted text: a guard for ``ab`` must not exempt ``a``."""
+    v = re.escape(var)
+    null_test = re.compile(
+        rf"(?:(?<![=!<>A-Za-z0-9_])!{v}\b"
+        rf"|\b{v}==(?:nullptr|NULL|0)\b"
+        rf"|\b(?:nullptr|NULL|0)=={v}\b)"
+    )
+    for cond in conds:
+        if not cond.strip():
+            continue
+        if all(
+            any(null_test.search(conj) for conj in _split_top_level(d, "&&"))
+            for d in _split_top_level(cond, "||")
+        ):
+            return True
+    return False
+
+
+_ALLOC_FNS = ("malloc", "calloc", "realloc")
+
+
+def _allocations(body: List[Tok]) -> List[Tuple[str, int, int]]:
+    """(pointer name, token index, line) for each ``p = ...malloc(...)``."""
+    out: List[Tuple[str, int, int]] = []
+    for i, t in enumerate(body):
+        if t.kind != "id" or t.text not in _ALLOC_FNS:
+            continue
+        if not (i + 1 < len(body) and body[i + 1].text == "("):
+            continue
+        # walk back across the cast chain to the '=' of this statement,
+        # then the identifier directly before it is the pointer
+        k = i - 1
+        while k >= 0 and body[k].text not in ("=", ";", "{", "}"):
+            k -= 1
+        if k > 0 and body[k].text == "=" and body[k - 1].kind == "id":
+            out.append((body[k - 1].text, i, t.line))
+    return out
+
+
+def _frees(body: List[Tok]) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for i, t in enumerate(body):
+        if (
+            t.kind == "id"
+            and t.text == "free"
+            and i + 2 < len(body)
+            and body[i + 1].text == "("
+            and body[i + 2].kind == "id"
+        ):
+            out.append((body[i + 2].text, i))
+    return out
+
+
+def _call_args(body: List[Tok], open_idx: int) -> List[List[Tok]]:
+    """Argument token groups of the call whose '(' sits at ``open_idx``."""
+    close = _match_forward(body, open_idx, "(", ")")
+    args: List[List[Tok]] = [[]]
+    depth = 0
+    for t in body[open_idx + 1 : close]:
+        if t.kind == "punct" and t.text in "([":
+            depth += 1
+        elif t.kind == "punct" and t.text in ")]":
+            depth -= 1
+        if t.kind == "punct" and t.text == "," and depth == 0:
+            args.append([])
+            continue
+        args[-1].append(t)
+    return [a for a in args if a]
+
+
+# ---------------------------------------------------------------------------
+# annotations
+
+
+_UNTRUSTED_RE = re.compile(
+    r"untrusted:\s*([A-Za-z_]\w*)\s*\[\s*([A-Za-z_]\w*|\d+)\s*\]"
+)
+
+
+def _untrusted_tags(
+    sf: analysis.SourceFile, fn: CppFunction
+) -> List[Tuple[str, str]]:
+    """``// untrusted: name[len]`` tags on the signature lines or the three
+    lines directly above them (multi-line signatures hang the tag
+    anywhere in that window)."""
+    tags: List[Tuple[str, str]] = []
+    for line in range(max(1, fn.sig_line - 3), fn.body_open_line + 1):
+        comment = sf.comment(line)
+        if comment:
+            tags.extend(_UNTRUSTED_RE.findall(comment))
+    return tags
+
+
+def _owns_caller(sf: analysis.SourceFile, fn: CppFunction, alloc_line: int) -> bool:
+    for line in (alloc_line, alloc_line - 1):
+        if "owns: caller" in sf.comment(line):
+            return True
+    for line in range(max(1, fn.sig_line - 3), fn.body_open_line + 1):
+        if "owns: caller" in sf.comment(line):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pass #10: native-leak
+
+
+class NativeBase(analysis.Pass):
+    languages = ("cpp",)
+
+
+class NativeLeakPass(NativeBase):
+    name = "native-leak"
+    codes = ("NATIVELEAK",)
+    description = (
+        "C++ malloc with a return path that neither frees it nor is "
+        "covered by '// owns: caller'"
+    )
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        for fn in functions_for(sf):
+            allocs = _allocations(fn.body)
+            if not allocs:
+                continue
+            frees = _frees(fn.body)
+            returns = _guarded_returns(fn.body)
+            for var, ai, aline in allocs:
+                if _owns_caller(sf, fn, aline):
+                    continue
+                for ri, rline, conds in returns:
+                    if ri < ai:
+                        continue
+                    if any(v == var and ai < fi < ri for (v, fi) in frees):
+                        continue
+                    if _null_guarded(conds, var):
+                        continue
+                    out.append(
+                        sf.finding(
+                            rline,
+                            self.name,
+                            "NATIVELEAK",
+                            f"{fn.name} returns without free({var}) — "
+                            f"allocated at line {aline}; free on every "
+                            "return path or annotate the allocation "
+                            "'// owns: caller'",
+                        )
+                    )
+                    break  # one finding per allocation: the first leaky path
+        return out
+
+
+# ---------------------------------------------------------------------------
+# pass #11: native-bound
+
+
+_CMP_OPS = frozenset({"<", "<=", ">", ">=", "==", "!="})
+
+
+class NativeBoundPass(NativeBase):
+    name = "native-bound"
+    codes = ("NATIVEBOUND",)
+    description = (
+        "'// untrusted: p[len]'-tagged C++ parameter used without a "
+        "dominating bounds comparison against its declared length"
+    )
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        for fn in functions_for(sf):
+            tags = _untrusted_tags(sf, fn)
+            if not tags:
+                continue
+            names = set(fn.param_names())
+            body = fn.body
+            for ptr, length in tags:
+                if ptr not in names:
+                    out.append(
+                        sf.finding(
+                            fn.sig_line,
+                            self.name,
+                            "NATIVEBOUND",
+                            f"{fn.name}: '// untrusted: {ptr}[{length}]' "
+                            "names no parameter of this function — fix the "
+                            "tag so the contract stays machine-checked",
+                        )
+                    )
+                    continue
+                fixed = int(length) if length.isdigit() else None
+                if fixed is None and length not in names:
+                    out.append(
+                        sf.finding(
+                            fn.sig_line,
+                            self.name,
+                            "NATIVEBOUND",
+                            f"{fn.name}: untrusted {ptr}'s declared length "
+                            f"'{length}' is not a parameter",
+                        )
+                    )
+                    continue
+                # positions where the LENGTH participates in a comparison
+                cmp_positions = []
+                if fixed is None:
+                    for i, t in enumerate(body):
+                        if t.kind == "id" and t.text == length:
+                            window = body[max(0, i - 2) : i + 3]
+                            if any(
+                                w.kind == "punct" and w.text in _CMP_OPS
+                                for w in window
+                            ):
+                                cmp_positions.append(i)
+                reported = set()
+                for i, t in enumerate(body):
+                    if t.kind != "id" or t.text != ptr:
+                        continue
+                    # a NULL test of the pointer itself is not an access —
+                    # but only the exact test shapes (!p, p ==/!= nullptr):
+                    # '*p != 71' is a real read of attacker bytes and must
+                    # stay in scope
+                    _NULLS = ("nullptr", "NULL", "0")
+                    prev1 = body[i - 1].text if i >= 1 else ""
+                    prev2 = body[i - 2].text if i >= 2 else ""
+                    next1 = body[i + 1].text if i + 1 < len(body) else ""
+                    next2 = body[i + 2].text if i + 2 < len(body) else ""
+                    if (
+                        prev1 == "!"
+                        or (next1 in ("==", "!=") and next2 in _NULLS)
+                        or (prev1 in ("==", "!=") and prev2 in _NULLS)
+                    ):
+                        continue
+                    if fixed is not None:
+                        # fixed window: literal indexes below it are fine
+                        if (
+                            i + 1 < len(body)
+                            and body[i + 1].text == "["
+                            and i + 3 < len(body)
+                            and body[i + 2].kind == "num"
+                            and body[i + 3].text == "]"
+                        ):
+                            idx = int(body[i + 2].text.rstrip("uUlL"), 0)
+                            if idx < fixed:
+                                continue
+                            msg = (
+                                f"{fn.name} indexes untrusted {ptr}[{idx}] "
+                                f"past its declared {fixed}-byte window"
+                            )
+                        else:
+                            msg = (
+                                f"{fn.name} uses untrusted {ptr} with a "
+                                "non-constant index/offset but its "
+                                f"declared length is the fixed "
+                                f"{fixed}-byte window — compare against "
+                                "an explicit length parameter instead"
+                            )
+                    else:
+                        if any(p < i for p in cmp_positions):
+                            continue
+                        msg = (
+                            f"{fn.name} reads untrusted {ptr} before any "
+                            f"bounds comparison against {length} — "
+                            "validate the size first; the decoder must "
+                            "refuse, never overrun"
+                        )
+                    if t.line not in reported:
+                        reported.add(t.line)
+                        out.append(
+                            sf.finding(t.line, self.name, "NATIVEBOUND", msg)
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# pass #12: native-ovfl
+
+
+_SIZE_ARGS = {"malloc": (0,), "calloc": (0, 1), "memcpy": (2,), "memmove": (2,)}
+_ARITH_OPS = frozenset({"*", "+", "-", "<<"})
+_TYPE_NAMES = frozenset(
+    {
+        "size_t", "ssize_t", "int8_t", "uint8_t", "int16_t", "uint16_t",
+        "int32_t", "uint32_t", "int64_t", "uint64_t", "int", "char",
+        "unsigned", "signed", "long", "short", "float", "double",
+        "static_cast", "reinterpret_cast", "sizeof", "const",
+    }
+)
+_CONST_NAME_RE = re.compile(r"^(?:k[A-Z]\w*|[A-Z][A-Z0-9_]+)$")
+
+
+def _sizet_locals(fn: CppFunction) -> frozenset:
+    """Identifiers declared ``size_t`` in the body or parameter list —
+    arithmetic purely over these is already full-width.  (Parameter types
+    come through ``_normalize_type``, which strips ``_t`` — so ``size``.)"""
+    names = {n for (t, n) in fn.params if t in ("size", "size_t")}
+    body = fn.body
+    for i, t in enumerate(body):
+        if (
+            t.kind == "id"
+            and t.text == "size_t"
+            and i + 1 < len(body)
+            and body[i + 1].kind == "id"
+        ):
+            names.add(body[i + 1].text)
+    return frozenset(names)
+
+
+def _constexpr_names(toks: List[Tok]) -> frozenset:
+    """Names declared ``const``/``constexpr <type> NAME = <constant expr>``
+    anywhere in the file — where the initializer (up to the ``;``) is
+    built ONLY from literals, operators, and already-known constants.
+    ``const int32_t total = a * b;`` is a narrow runtime product, not a
+    constant: merely adding ``const`` must not defeat the overflow pass."""
+    out = set()
+    for i, t in enumerate(toks):
+        if t.kind == "id" and t.text in ("constexpr", "const"):
+            j = i + 1
+            while j < len(toks) and toks[j].kind == "id" and toks[j].text in _TYPE_NAMES:
+                j += 1
+            if not (
+                j < len(toks)
+                and toks[j].kind == "id"
+                and j + 1 < len(toks)
+                and toks[j + 1].text == "="
+            ):
+                continue
+            constant_init = True
+            k = j + 2
+            while k < len(toks) and toks[k].text != ";":
+                tk = toks[k]
+                if tk.kind == "id" and not (
+                    tk.text in out or _CONST_NAME_RE.match(tk.text)
+                ):
+                    constant_init = False
+                    break
+                if tk.kind in ("str", "char"):
+                    constant_init = False
+                    break
+                k += 1
+            if constant_init:
+                out.add(toks[j].text)
+    return frozenset(out)
+
+
+def _is_widened(arg: List[Tok]) -> bool:
+    """Left operand carries the widening: after stripping leading parens the
+    expression starts with a ``(size_t)`` / ``static_cast<size_t>`` cast or
+    ``sizeof``."""
+    k = 0
+    while k < len(arg) and arg[k].kind == "punct" and arg[k].text == "(":
+        k += 1
+    if k >= len(arg):
+        return False
+    t = arg[k]
+    if t.kind == "id" and t.text in ("size_t", "uint64_t", "sizeof"):
+        return True
+    if (
+        t.kind == "id"
+        and t.text == "static_cast"
+        and k + 2 < len(arg)
+        and arg[k + 1].text == "<"
+        and arg[k + 2].text in ("size_t", "uint64_t")
+    ):
+        return True
+    return False
+
+
+class NativeOvflPass(NativeBase):
+    name = "native-ovfl"
+    codes = ("NATIVEOVFL",)
+    description = (
+        "C++ size arithmetic fed to malloc/calloc/memcpy/memmove without "
+        "(size_t) widening on the left operand"
+    )
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        constants = constants_for(sf)
+        for fn in functions_for(sf):
+            body = fn.body
+            sizet = _sizet_locals(fn)
+            for i, t in enumerate(body):
+                if t.kind != "id" or t.text not in _SIZE_ARGS:
+                    continue
+                if not (i + 1 < len(body) and body[i + 1].text == "("):
+                    continue
+                args = _call_args(body, i + 1)
+                for argno in _SIZE_ARGS[t.text]:
+                    if argno >= len(args):
+                        continue
+                    arg = args[argno]
+                    if not any(
+                        a.kind == "punct" and a.text in _ARITH_OPS for a in arg
+                    ):
+                        continue
+                    if _is_widened(arg):
+                        continue
+                    idents = [
+                        a.text
+                        for a in arg
+                        if a.kind == "id"
+                        and a.text not in _TYPE_NAMES
+                        and a.text not in constants
+                        and not _CONST_NAME_RE.match(a.text)
+                    ]
+                    suspects = [x for x in idents if x not in sizet]
+                    if not suspects:
+                        continue
+                    expr = " ".join(a.text for a in arg)
+                    out.append(
+                        sf.finding(
+                            t.line,
+                            self.name,
+                            "NATIVEOVFL",
+                            f"{fn.name}: {t.text}() size '{expr}' does "
+                            "narrow arithmetic before widening — the "
+                            "overflow happens in the narrow type; write "
+                            f"the left operand as (size_t){suspects[0]}",
+                        )
+                    )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# pass #13: native-abi
+
+
+_SIG_TABLE_CACHE: Dict[str, Tuple[float, Dict]] = {}
+
+
+def _signature_table_path() -> str:
+    return os.path.join(analysis.package_root(), "utils", "native.py")
+
+
+def load_signature_table(path: Optional[str] = None) -> Dict:
+    """``NATIVE_SIGNATURES`` parsed straight out of utils/native.py's
+    source with ``ast`` — single-sourced with the runtime ctypes bindings
+    and never imported (the analyzer stays import-free of the package)."""
+    if path is None:
+        path = _signature_table_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    cached = _SIG_TABLE_CACHE.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    table: Dict = {}
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "NATIVE_SIGNATURES":
+                    table = ast.literal_eval(node.value)
+    _SIG_TABLE_CACHE[path] = (mtime, table)
+    return table
+
+
+# ABI shape: (scalar-or-pointer, int-or-float pointee/value, width in bytes).
+# char*/uint8* are the same 1-byte-pointee pointer — ctypes c_char_p vs
+# POINTER(c_uint8) is a Python-side convenience distinction, not ABI drift.
+_ABI_CLASS = {
+    "char*": ("ptr", "i", 1),
+    "int8*": ("ptr", "i", 1),
+    "uint8*": ("ptr", "i", 1),
+    "int16*": ("ptr", "i", 2),
+    "uint16*": ("ptr", "i", 2),
+    "int32*": ("ptr", "i", 4),
+    "uint32*": ("ptr", "i", 4),
+    "int64*": ("ptr", "i", 8),
+    "uint64*": ("ptr", "i", 8),
+    "float*": ("ptr", "f", 4),
+    "double*": ("ptr", "f", 8),
+    "int": ("val", "i", 4),
+    "int32": ("val", "i", 4),
+    "uint32": ("val", "i", 4),
+    "int64": ("val", "i", 8),
+    "uint64": ("val", "i", 8),
+    "float": ("val", "f", 4),
+    "double": ("val", "f", 8),
+}
+
+
+def _abi(tok: str):
+    return _ABI_CLASS.get(tok, ("?", tok, 0))
+
+
+class NativeAbiPass(NativeBase):
+    name = "native-abi"
+    codes = ("NATIVEABI",)
+    description = (
+        'every extern "C" export matches the declared ctypes signature in '
+        "utils/native.py NATIVE_SIGNATURES by name/arity/argument width"
+    )
+
+    def run(self, sf: analysis.SourceFile) -> List[analysis.Finding]:
+        out: List[analysis.Finding] = []
+        table = load_signature_table()
+        if not table:
+            return out
+        for fn in functions_for(sf):
+            if not fn.extern_c:
+                continue
+            declared = table.get(fn.name)
+            if declared is None:
+                out.append(
+                    sf.finding(
+                        fn.sig_line,
+                        self.name,
+                        "NATIVEABI",
+                        f'extern "C" export {fn.name} has no declared '
+                        "ctypes signature in utils/native.py "
+                        "NATIVE_SIGNATURES — an unbound or drifting C ABI "
+                        "is silent memory corruption; add the row",
+                    )
+                )
+                continue
+            want_args, want_ret = declared
+            if len(want_args) != len(fn.params):
+                out.append(
+                    sf.finding(
+                        fn.sig_line,
+                        self.name,
+                        "NATIVEABI",
+                        f"{fn.name} takes {len(fn.params)} parameter(s) "
+                        f"but utils/native.py declares {len(want_args)} — "
+                        "ctypes would push the wrong frame",
+                    )
+                )
+                continue
+            for k, ((have_t, pname), want_t) in enumerate(
+                zip(fn.params, want_args)
+            ):
+                if _abi(have_t) != _abi(want_t):
+                    out.append(
+                        sf.finding(
+                            fn.sig_line,
+                            self.name,
+                            "NATIVEABI",
+                            f"{fn.name} parameter {k} ({pname or '?'}: "
+                            f"{have_t}) does not match the declared "
+                            f"ctypes width {want_t} — cross-language "
+                            "width drift truncates or sign-extends "
+                            "silently",
+                        )
+                    )
+            if _abi(fn.ret_type) != _abi(want_ret):
+                out.append(
+                    sf.finding(
+                        fn.sig_line,
+                        self.name,
+                        "NATIVEABI",
+                        f"{fn.name} returns {fn.ret_type} but "
+                        f"utils/native.py declares restype {want_ret}",
+                    )
+                )
+        return out
+
+
+analysis.register(NativeLeakPass())
+analysis.register(NativeBoundPass())
+analysis.register(NativeOvflPass())
+analysis.register(NativeAbiPass())

@@ -51,6 +51,93 @@ _lock = threading.Lock()
 _lib = None
 _tried = False
 
+# ---------------------------------------------------------------------------
+# Single-source C ABI signature table.
+#
+# One row per extern "C" export of native_src/edge_parser.cpp:
+# name -> (argument type tokens, result type token).  The loader below
+# binds ctypes argtypes/restype FROM this table, and graftcheck's
+# native-abi pass (analysis/nativecheck.py, NATIVEABI) parses the same
+# literal out of this file with ``ast`` and diffs it against the C++
+# signatures — so a drifting export fails the gate instead of silently
+# corrupting memory across the language boundary.  Keep the value a PURE
+# LITERAL (no computed entries): the analyzer reads it without importing.
+#
+# Type tokens: scalars ``int32``/``int64``/``double``; pointers with a
+# trailing ``*``.  ``char*`` binds as c_char_p (Python bytes in), which is
+# ABI-identical to ``uint8*`` — the analyzer treats 1-byte-pointee
+# pointers as one class.
+NATIVE_SIGNATURES = {
+    "count_rows": (("char*",), "int64"),
+    "fill_edges": (
+        ("char*", "int64*", "int64*", "double*", "int64*", "int32*",
+         "int64", "int32*"),
+        "int64",
+    ),
+    "fill_edges_range": (
+        ("char*", "int64", "int64", "int64*", "int64*", "double*",
+         "int64*", "int32*", "int64", "int32*"),
+        "int64",
+    ),
+    "count_rows_range": (("char*", "int64", "int64"), "int64"),
+    "pack_edges": (
+        ("int32*", "int32*", "int64", "int32", "uint8*"),
+        "int64",
+    ),
+    "pack_edges40": (("int32*", "int32*", "int64", "uint8*"), "int64"),
+    "pack_edges_ef40": (
+        ("int32*", "int32*", "int64", "int32", "uint8*", "int64"),
+        "int64",
+    ),
+    "sort_edges_dst_src": (
+        ("int32*", "int32*", "int64", "int32", "int32*", "int32*"),
+        "int64",
+    ),
+    "encode_edges_bdv": (
+        ("int32*", "int32*", "int64", "uint8*", "int64"),
+        "int64",
+    ),
+    "route_edges": (
+        ("int32*", "int32*", "int64", "int32", "int32", "int64",
+         "int32*", "int32*", "int64*"),
+        "int64",
+    ),
+    "cc_baseline": (
+        ("int32*", "int32*", "int64", "int32*", "int32"),
+        "int64",
+    ),
+    "flink_proxy_cc": (
+        ("int32*", "int32*", "int64", "int32*", "int32"),
+        "int64",
+    ),
+    "flink_proxy_degrees": (
+        ("int32*", "int32*", "int64", "int64*", "int32"),
+        "int64",
+    ),
+    # serving data plane (ISSUE 14): GLY1 frame probe + one-pass wire
+    # decode into transfer arenas (runtime/protocol.py, io/wire.py)
+    "gly1_probe_prefix": (
+        ("char*", "int64", "int64", "int64*", "int64*"),
+        "int32",
+    ),
+    "decode_wire_into": (
+        ("uint8*", "int64", "int64", "int32", "int32", "int32",
+         "int32*", "int32*"),
+        "int64",
+    ),
+}
+
+_CTYPE_TOKENS = {
+    "char*": ctypes.c_char_p,
+    "int32": ctypes.c_int32,
+    "int64": ctypes.c_int64,
+    "double": ctypes.c_double,
+    "uint8*": ctypes.POINTER(ctypes.c_uint8),
+    "int32*": ctypes.POINTER(ctypes.c_int32),
+    "int64*": ctypes.POINTER(ctypes.c_int64),
+    "double*": ctypes.POINTER(ctypes.c_double),
+}
+
 
 def _build() -> Optional[str]:
     try:
@@ -93,152 +180,16 @@ def load_ingest_lib():
         if so is None:
             return None
         lib = ctypes.CDLL(so)
-        lib.count_rows.argtypes = [ctypes.c_char_p]
-        lib.count_rows.restype = ctypes.c_int64
-        lib.fill_edges.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.fill_edges.restype = ctypes.c_int64
-        # byte-range workers of the parallel ingest pool (io/ingest.py);
-        # bound only when the .so carries them (prebuilt libs may predate)
-        if hasattr(lib, "fill_edges_range"):
-            lib.fill_edges_range.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_double),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int32),
-            ]
-            lib.fill_edges_range.restype = ctypes.c_int64
-        if hasattr(lib, "count_rows_range"):
-            lib.count_rows_range.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_int64,
-                ctypes.c_int64,
-            ]
-            lib.count_rows_range.restype = ctypes.c_int64
-        lib.cc_baseline.argtypes = [
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int32,
-        ]
-        lib.cc_baseline.restype = ctypes.c_int64
-        # A prebuilt .so may predate newer symbols; bind them only when present
-        # so callers can keep their pure-numpy fallbacks instead of crashing.
-        if hasattr(lib, "pack_edges"):
-            lib.pack_edges.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_uint8),
-            ]
-            lib.pack_edges.restype = ctypes.c_int64
-        if hasattr(lib, "pack_edges40"):
-            lib.pack_edges40.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_uint8),
-            ]
-            lib.pack_edges40.restype = ctypes.c_int64
-        if hasattr(lib, "route_edges"):
-            lib.route_edges.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.c_int32,
-                ctypes.c_int32,
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int64),
-            ]
-            lib.route_edges.restype = ctypes.c_int64
-        if hasattr(lib, "flink_proxy_cc"):
-            lib.flink_proxy_cc.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int32,
-            ]
-            lib.flink_proxy_cc.restype = ctypes.c_int64
-        if hasattr(lib, "flink_proxy_degrees"):
-            lib.flink_proxy_degrees.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int32,
-            ]
-            lib.flink_proxy_degrees.restype = ctypes.c_int64
-        if hasattr(lib, "sort_edges_dst_src"):
-            lib.sort_edges_dst_src.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-            ]
-            lib.sort_edges_dst_src.restype = ctypes.c_int64
-        if hasattr(lib, "encode_edges_bdv"):
-            lib.encode_edges_bdv.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int64,
-            ]
-            lib.encode_edges_bdv.restype = ctypes.c_int64
-        if hasattr(lib, "pack_edges_ef40"):
-            lib.pack_edges_ef40.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int64,
-                ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int64,
-            ]
-            lib.pack_edges_ef40.restype = ctypes.c_int64
-        # serving data plane (ISSUE 14): GLY1 frame probe + one-pass wire
-        # decode into transfer arenas (runtime/protocol.py, io/wire.py)
-        if hasattr(lib, "gly1_probe_prefix"):
-            lib.gly1_probe_prefix.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int64),
-            ]
-            lib.gly1_probe_prefix.restype = ctypes.c_int32
-        if hasattr(lib, "decode_wire_into"):
-            lib.decode_wire_into.argtypes = [
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.c_int32,
-                ctypes.c_int32,
-                ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-            ]
-            lib.decode_wire_into.restype = ctypes.c_int64
+        # Bind every declared export straight from the signature table.  A
+        # prebuilt .so may predate newer symbols, so each is bound only
+        # when present — callers keep their pure-numpy fallbacks instead
+        # of crashing on a missing attribute.
+        for name, (arg_tokens, ret_token) in NATIVE_SIGNATURES.items():
+            if not hasattr(lib, name):
+                continue
+            fn = getattr(lib, name)
+            fn.argtypes = [_CTYPE_TOKENS[t] for t in arg_tokens]
+            fn.restype = _CTYPE_TOKENS[ret_token]
         _lib = lib
         return _lib
 

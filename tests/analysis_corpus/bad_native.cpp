@@ -1,0 +1,48 @@
+// Seeded-defect twin for the nativecheck pass family (#10-#13): every
+// finding below is asserted by exact code in tests/test_analysis.py, so a
+// checker that finds nothing anywhere fails there instead of passing
+// vacuously.  The shapes mirror the real native tree: a ctypes export
+// drifting from utils/native.py, untrusted socket bytes read before any
+// size check, narrow size arithmetic, and an early return that leaks.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// NATIVEABI (arity): utils/native.py declares count_rows(path) — the
+// extra flag pushes a frame ctypes never marshals
+int64_t count_rows(const char* path, int64_t bogus_flag) {
+  (void)path;
+  return bogus_flag;
+}
+
+// NATIVEABI (width): capacity is int32 in NATIVE_SIGNATURES; int64 here
+// reads 4 bytes of stack garbage into the upper half
+int64_t cc_baseline(const int32_t* src, const int32_t* dst, int64_t n,
+                    int32_t* parent, int64_t capacity) {
+  (void)src;
+  (void)dst;
+  (void)parent;
+  (void)capacity;
+  return n;
+}
+
+// NATIVEABI (unlisted): an export with no ctypes row is a C ABI nobody
+// declared — the first Python caller to guess the signature corrupts it.
+// The body seeds the three memory rules:
+// untrusted: buf[nbytes]
+int64_t decode_probe(const uint8_t* buf, int64_t nbytes, int64_t n,
+                     int32_t* out) {
+  int32_t* tmp = static_cast<int32_t*>(malloc((n + 1) * 4));  // NATIVEOVFL
+  if (!tmp) return -4;  // exempt: the allocation's own failure guard
+  for (int64_t i = 0; i < n; ++i) {
+    tmp[i] = buf[2 * i];  // NATIVEBOUND: no comparison against nbytes ran
+  }
+  if (tmp[0] < 0) return -2;  // NATIVELEAK: refusal path drops tmp
+  memcpy(out, tmp, n * 4);  // NATIVEOVFL: narrow arithmetic again
+  free(tmp);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+// Clean twin for the nativecheck pass family (#10-#13): the same shapes
+// as bad_native.cpp written to contract, plus the C++ suppression grammar
+// (// graft: disable=CODE — justification) — the whole file must scan to
+// zero findings in tests/test_analysis.py.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// matches utils/native.py NATIVE_SIGNATURES exactly: (char*) -> int64
+int64_t count_rows(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char* buf = static_cast<char*>(malloc((size_t)1 << 12));
+  if (!buf) {  // alloc-failure guard: nothing to leak, nothing to deref
+    fclose(f);
+    return -1;
+  }
+  int64_t rows = 0;
+  size_t nread;
+  while ((nread = fread(buf, 1, (size_t)1 << 12, f)) > 0) {
+    for (size_t i = 0; i < nread; ++i) rows += (buf[i] == '\n');
+  }
+  free(buf);  // every return path below the allocation releases it
+  fclose(f);
+  return rows;
+}
+
+// fixed untrusted window done right: the caller contract is exactly 12
+// prefix bytes, and every read is a constant index inside it
+// untrusted: prefix[12]
+int32_t gly1_probe_prefix(const uint8_t* prefix, int64_t max_header,
+                          int64_t max_payload, int64_t* header_len,
+                          int64_t* payload_len) {
+  uint32_t h = ((uint32_t)prefix[4] << 24) | ((uint32_t)prefix[5] << 16) |
+               ((uint32_t)prefix[6] << 8) | (uint32_t)prefix[7];
+  uint32_t p = ((uint32_t)prefix[8] << 24) | ((uint32_t)prefix[9] << 16) |
+               ((uint32_t)prefix[10] << 8) | (uint32_t)prefix[11];
+  *header_len = (int64_t)h;
+  *payload_len = (int64_t)p;
+  if (prefix[0] != 'G' || prefix[1] != 'L' || prefix[2] != 'Y' ||
+      prefix[3] != '1') {
+    return -1;
+  }
+  if ((int64_t)h > max_header) return -2;
+  if ((int64_t)p > max_payload) return -3;
+  return 0;
+}
+
+// length-parameter untrusted window done right: nbytes is compared before
+// any byte of buf is touched, the size is widened before the arithmetic,
+// and the scratch pointer is released on every path past its allocation
+// untrusted: buf[nbytes]
+int64_t decode_wire_into(const uint8_t* buf, int64_t nbytes, int64_t n,
+                         int32_t width_code, int32_t capacity, int32_t sort,
+                         int32_t* out_src, int32_t* out_dst) {
+  if (width_code != 2 || sort != 0) return -4;
+  if (n < 0 || capacity <= 0) return -1;
+  if (nbytes != 4 * n) return -1;  // the dominating bounds comparison
+  int32_t* tmp = static_cast<int32_t*>(malloc(((size_t)n + 1) * 4));
+  if (!tmp) return -4;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t v = (uint32_t)buf[2 * i] | ((uint32_t)buf[2 * i + 1] << 8);
+    if ((int32_t)v >= capacity) {
+      free(tmp);  // refusal path releases before returning
+      return -2;
+    }
+    tmp[i] = (int32_t)v;
+  }
+  memcpy(out_src, tmp, (size_t)n * 4);
+  memcpy(out_dst, tmp, (size_t)n * 4);
+  free(tmp);
+  return n;
+}
+
+}  // extern "C"
+
+namespace {
+
+// a static helper is no ctypes export (no NATIVEABI row needed), and a
+// justified suppression silences the one rule the caller's clamp makes
+// moot — the framework must honor the C++ grammar here
+int64_t scratch_probe(int64_t n) {
+  // graft: disable=NATIVEOVFL — n is clamped to <= 4096 by the only caller
+  char* p = static_cast<char*>(malloc(n * 2));
+  if (!p) return -1;
+  p[0] = 0;
+  free(p);
+  return n;
+}
+
+}  // namespace
+
+extern "C" int64_t count_rows_range(const char* path, int64_t begin,
+                                    int64_t end_off) {
+  (void)path;
+  return scratch_probe(end_off - begin);
+}

@@ -1,0 +1,437 @@
+// Sanitizer/fuzz harness for the native decode plane (ISSUE 15).
+//
+// Compiled by tests/test_native_sanitizers.py with
+//   g++ -O1 -g -std=c++17 -pthread -fsanitize=address,undefined
+//       -fno-sanitize-recover=all
+// so every heap overrun, use-after-free, signed overflow, or misaligned
+// access in the canonical source aborts the process instead of silently
+// corrupting a decode.  Three subcommands:
+//
+//   selfcheck             deterministic round-trip/invariant checks of the
+//                         packers, sorter, BDV encoder, wire decoder, and
+//                         the GLY1 prefix probe (the native build gate's
+//                         checks, replayed under instrumentation)
+//   fuzz <seed> <iters>   structure-aware fuzzing: valid fixed/PAIR40/BDV
+//                         buffers and GLY1 prefixes built from a seeded
+//                         xorshift PRNG, then mutated (byte flips, size
+//                         lies, truncations) and fed to the decode plane.
+//                         Buffers are heap-allocated at EXACTLY the size
+//                         the decoder is told, so any read past nbytes is
+//                         an ASan abort, not luck.
+//   replay <file>...      byte-for-byte replay of persisted regression
+//                         inputs (tests/fuzz_corpus/*.bin, GFZ1 format —
+//                         see tests/fuzz_corpus/README.md)
+//
+// Exit 0 means no sanitizer report and no invariant violation.  The
+// harness never asserts WHICH verdict a mutated buffer gets (that parity
+// is the tier-1 numpy-oracle replay's job) — only that the decoder
+// refuses or accepts without touching memory it does not own.
+
+#include "../gelly_streaming_tpu/native_src/edge_parser.cpp"
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+uint64_t g_rng_state = 0x9E3779B97F4A7C15ull;
+
+uint64_t rng() {
+  uint64_t x = g_rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  g_rng_state = x;
+  return x;
+}
+
+uint32_t rng_below(uint32_t bound) {
+  return bound ? (uint32_t)(rng() % bound) : 0;
+}
+
+[[noreturn]] void die(const char* what) {
+  fprintf(stderr, "harness invariant violated: %s\n", what);
+  exit(1);
+}
+
+void check(bool ok, const char* what) {
+  if (!ok) die(what);
+}
+
+// Exact-size heap copy: the decoder is told `nbytes`, and that is the
+// allocation's true extent — ASan turns any overrun into an abort.
+struct ExactBuf {
+  uint8_t* p;
+  int64_t n;
+  explicit ExactBuf(int64_t nbytes) : n(nbytes) {
+    p = static_cast<uint8_t*>(malloc(nbytes > 0 ? (size_t)nbytes : 1));
+    if (!p) die("harness oom");
+  }
+  ~ExactBuf() { free(p); }
+  ExactBuf(const ExactBuf&) = delete;
+  ExactBuf& operator=(const ExactBuf&) = delete;
+};
+
+int64_t bdv_worst_case(int64_t n) { return (2 * n + 3) / 4 + 8 * n; }
+
+// Build one valid wire buffer for `code` over ids < capacity; returns the
+// byte size and fills src/dst with the encoded edges.
+int64_t build_valid(int code, int64_t n, int32_t capacity,
+                    std::vector<int32_t>& src, std::vector<int32_t>& dst,
+                    std::vector<uint8_t>& out) {
+  src.resize((size_t)n);
+  dst.resize((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    src[(size_t)i] = (int32_t)rng_below((uint32_t)capacity);
+    dst[(size_t)i] = (int32_t)rng_below((uint32_t)capacity);
+  }
+  if (code >= 2 && code <= 4) {
+    out.resize((size_t)(2 * n * code));
+    int64_t wrote = pack_edges(src.data(), dst.data(), n, code, out.data());
+    check(wrote == (int64_t)out.size(), "pack_edges size");
+    return wrote;
+  }
+  if (code == 5) {
+    out.resize((size_t)(5 * n));
+    int64_t wrote = pack_edges40(src.data(), dst.data(), n, out.data());
+    check(wrote == (int64_t)out.size(), "pack_edges40 size");
+    return wrote;
+  }
+  // BDV: encode a (dst, src)-sorted copy; buffer sized at the worst case
+  std::vector<int32_t> ss((size_t)n), dd((size_t)n);
+  if (n > 0) {
+    check(sort_edges_dst_src(src.data(), dst.data(), n, capacity, ss.data(),
+                             dd.data()) == n,
+          "sorter refused valid input");
+  }
+  src = ss;
+  dst = dd;
+  out.resize((size_t)bdv_worst_case(n) + 1);
+  int64_t wrote = encode_edges_bdv(src.data(), dst.data(), n, out.data(),
+                                   (int64_t)out.size());
+  check(wrote >= 0, "encoder refused sorted input");
+  out.resize((size_t)wrote);
+  return wrote;
+}
+
+// Decode with exact-extent buffers and exact-size outputs; verdicts are
+// sanity-bounded, accepted ids must be in range.
+void run_decode(const uint8_t* bytes, int64_t nbytes, int64_t n, int code,
+                int32_t capacity, int32_t sort) {
+  if (n < 0 || n > (int64_t)1 << 22) return;
+  ExactBuf buf(nbytes);
+  if (nbytes > 0) memcpy(buf.p, bytes, (size_t)nbytes);
+  std::vector<int32_t> os((size_t)n), od((size_t)n);
+  int64_t rc = decode_wire_into(buf.p, nbytes, n, code, capacity, sort,
+                                os.data(), od.data());
+  check(rc == n || (rc >= -4 && rc < 0), "decode verdict out of taxonomy");
+  if (rc == n) {
+    for (int64_t i = 0; i < n; ++i) {
+      check((uint32_t)os[(size_t)i] < (uint32_t)capacity &&
+                (uint32_t)od[(size_t)i] < (uint32_t)capacity,
+            "accepted id out of range");
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+
+int selfcheck() {
+  // GLY1 probe taxonomy over its whole refusal surface
+  {
+    uint8_t p[12] = {'G', 'L', 'Y', '1', 0, 0, 0, 7, 0, 0, 0, 9};
+    int64_t h = -1, pl = -1;
+    check(gly1_probe_prefix(p, 1 << 16, 1 << 26, &h, &pl) == 0, "probe ok");
+    check(h == 7 && pl == 9, "probe lengths");
+    p[0] = 'X';
+    check(gly1_probe_prefix(p, 1 << 16, 1 << 26, &h, &pl) == -1, "probe magic");
+    p[0] = 'G';
+    check(gly1_probe_prefix(p, 6, 1 << 26, &h, &pl) == -2, "probe header cap");
+    check(gly1_probe_prefix(p, 1 << 16, 8, &h, &pl) == -3, "probe payload cap");
+  }
+  // every push encoding round-trips through the decoder, including the
+  // decode+bin fused pass and the n == 0 edge
+  const int codes[] = {2, 3, 4, 5, 6};
+  const int32_t caps[] = {1 << 14, 1 << 20, 1 << 20, 1 << 20, 1 << 12};
+  for (int k = 0; k < 5; ++k) {
+    int code = codes[k];
+    int32_t cap = caps[k];
+    for (int64_t n : {(int64_t)0, (int64_t)1, (int64_t)513}) {
+      std::vector<int32_t> src, dst;
+      std::vector<uint8_t> wire;
+      int64_t nbytes = build_valid(code, n, cap, src, dst, wire);
+      ExactBuf buf(nbytes);
+      if (nbytes > 0) memcpy(buf.p, wire.data(), (size_t)nbytes);
+      std::vector<int32_t> os((size_t)n), od((size_t)n);
+      int64_t rc =
+          decode_wire_into(buf.p, nbytes, n, code, cap, 0, os.data(), od.data());
+      check(rc == n, "valid buffer refused");
+      for (int64_t i = 0; i < n; ++i) {
+        check(os[(size_t)i] == src[(size_t)i] && od[(size_t)i] == dst[(size_t)i],
+              "decode drifted from encode");
+      }
+      // fused decode+bin equals decode-then-sort
+      std::vector<int32_t> bs((size_t)n), bd((size_t)n);
+      rc = decode_wire_into(buf.p, nbytes, n, code, cap, 1, bs.data(), bd.data());
+      check(rc == n, "fused binning refused valid buffer");
+      std::vector<int32_t> es((size_t)n), ed((size_t)n);
+      if (n > 0) {
+        check(sort_edges_dst_src(src.data(), dst.data(), n, cap, es.data(),
+                                 ed.data()) == n,
+              "sorter refused");
+      }
+      for (int64_t i = 0; i < n; ++i) {
+        check(bs[(size_t)i] == es[(size_t)i] && bd[(size_t)i] == ed[(size_t)i],
+              "fused binning drifted from two-pass");
+      }
+    }
+  }
+  // sorter: output is (dst, src)-nondecreasing and the same multiset
+  {
+    int64_t n = 4096;
+    int32_t cap = 1 << 20;
+    std::vector<int32_t> s((size_t)n), d((size_t)n), os((size_t)n), od((size_t)n);
+    for (int64_t i = 0; i < n; ++i) {
+      s[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+      d[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+    }
+    check(sort_edges_dst_src(s.data(), d.data(), n, cap, os.data(), od.data()) ==
+              n,
+          "sorter refused valid");
+    std::vector<uint64_t> want((size_t)n), got((size_t)n);
+    for (int64_t i = 0; i < n; ++i) {
+      want[(size_t)i] = ((uint64_t)(uint32_t)d[(size_t)i] << 32) |
+                        (uint32_t)s[(size_t)i];
+      got[(size_t)i] = ((uint64_t)(uint32_t)od[(size_t)i] << 32) |
+                       (uint32_t)os[(size_t)i];
+    }
+    for (int64_t i = 1; i < n; ++i) {
+      check(got[(size_t)i - 1] <= got[(size_t)i], "sorter order");
+    }
+    std::sort(want.begin(), want.end());
+    std::vector<uint64_t> got_sorted = got;
+    std::sort(got_sorted.begin(), got_sorted.end());
+    check(want == got_sorted, "sorter multiset");
+    // out-of-range ids refuse instead of scribbling count tables
+    s[0] = cap;
+    check(sort_edges_dst_src(s.data(), d.data(), n, cap, os.data(), od.data()) ==
+              -1,
+          "sorter accepted out-of-range id");
+  }
+  // EF40 pack stays inside its declared out_cap; the short-buffer refusal
+  // happens before any write
+  {
+    int64_t n = 1021;
+    int32_t cap = 1 << 16;
+    std::vector<int32_t> s((size_t)n), d((size_t)n);
+    for (int64_t i = 0; i < n; ++i) {
+      s[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+      d[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+    }
+    int64_t out_cap = (n + cap + 7) / 8 + ((n + 1) / 2) * 5;
+    ExactBuf out(out_cap);
+    int64_t wrote =
+        pack_edges_ef40(s.data(), d.data(), n, cap, out.p, out_cap);
+    check(wrote == out_cap, "ef40 size");
+    check(pack_edges_ef40(s.data(), d.data(), n, cap, out.p, out_cap - 1) == -1,
+          "ef40 accepted short buffer");
+  }
+  // route_edges conserves edges and respects the floored modulo
+  {
+    int64_t n = 777;
+    int32_t shards = 5;
+    int64_t cap = n;
+    std::vector<int32_t> s((size_t)n), d((size_t)n);
+    std::vector<int32_t> os((size_t)(shards * cap)), od((size_t)(shards * cap));
+    std::vector<int64_t> counts((size_t)shards);
+    for (int64_t i = 0; i < n; ++i) {
+      s[(size_t)i] = (int32_t)rng_below(1 << 20);
+      d[(size_t)i] = (int32_t)rng_below(1 << 20);
+    }
+    check(route_edges(s.data(), d.data(), n, shards, 1, cap, os.data(),
+                      od.data(), counts.data()) == n,
+          "router lost edges");
+  }
+  // cc_baseline labels are a fixpoint (every label points at itself)
+  {
+    int32_t cap = 512;
+    int64_t n = 2048;
+    std::vector<int32_t> s((size_t)n), d((size_t)n), parent((size_t)cap);
+    for (int64_t i = 0; i < n; ++i) {
+      s[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+      d[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+    }
+    check(cc_baseline(s.data(), d.data(), n, parent.data(), cap) >= 0,
+          "cc_baseline failed");
+    for (int32_t v = 0; v < cap; ++v) {
+      check(parent[(size_t)parent[(size_t)v]] == parent[(size_t)v],
+            "cc labels not flattened");
+    }
+  }
+  printf("selfcheck ok\n");
+  return 0;
+}
+
+int fuzz(uint64_t seed, int64_t iters) {
+  g_rng_state = seed ? seed : 1;
+  for (int64_t it = 0; it < iters; ++it) {
+    uint32_t pick = rng_below(100);
+    if (pick < 70) {
+      // mutated wire buffer through the full decode plane
+      const int codes[] = {2, 3, 4, 5, 6};
+      int code = codes[rng_below(5)];
+      int64_t n = rng_below(1024);
+      int32_t cap = 1 + (int32_t)rng_below(code == 6 ? (1u << 20) : (1u << 16));
+      std::vector<int32_t> src, dst;
+      std::vector<uint8_t> wire;
+      int64_t nbytes = build_valid(code, n, cap, src, dst, wire);
+      // mutate: byte flips, then maybe lie about the size / batch / cap
+      uint32_t flips = rng_below(8);
+      for (uint32_t f = 0; f < flips && nbytes > 0; ++f) {
+        wire[(size_t)rng_below((uint32_t)nbytes)] ^= (uint8_t)(1 + rng_below(255));
+      }
+      int64_t claim_bytes = nbytes;
+      int64_t claim_n = n;
+      int32_t claim_cap = cap;
+      switch (rng_below(6)) {
+        case 0:
+          claim_bytes = (int64_t)rng_below((uint32_t)nbytes + 16);
+          break;
+        case 1:
+          claim_n = (int64_t)rng_below((uint32_t)n + 8);
+          break;
+        case 2:
+          claim_cap = 1 + (int32_t)rng_below(1 << 10);
+          break;
+        default:
+          break;
+      }
+      if (claim_bytes > (int64_t)wire.size()) {
+        wire.resize((size_t)claim_bytes);  // extension bytes are PRNG junk
+        for (int64_t k = nbytes; k < claim_bytes; ++k) {
+          wire[(size_t)k] = (uint8_t)rng();
+        }
+      }
+      run_decode(wire.data(), claim_bytes, claim_n, code, claim_cap,
+                 (int32_t)rng_below(2));
+    } else if (pick < 85) {
+      // GLY1 prefixes: valid magic half the time, junk otherwise
+      ExactBuf p(12);
+      for (int k = 0; k < 12; ++k) p.p[k] = (uint8_t)rng();
+      if (rng_below(2)) memcpy(p.p, "GLY1", 4);
+      int64_t h = 0, pl = 0;
+      int32_t rc = gly1_probe_prefix(p.p, 1 << 16, 1 << 26, &h, &pl);
+      check(rc == 0 || (rc >= -3 && rc < 0), "probe verdict out of taxonomy");
+    } else if (pick < 95) {
+      // encoder: arbitrary (not necessarily sorted) input must refuse or
+      // stay inside the worst-case buffer
+      int64_t n = rng_below(512);
+      std::vector<int32_t> s((size_t)n), d((size_t)n);
+      for (int64_t i = 0; i < n; ++i) {
+        s[(size_t)i] = (int32_t)rng_below(1 << 20);
+        d[(size_t)i] = (int32_t)rng_below(1 << 20);
+      }
+      if (rng_below(2) && n > 1) std::sort(d.begin(), d.end());
+      int64_t cap_bytes = bdv_worst_case(n);
+      ExactBuf out(cap_bytes);
+      int64_t wrote =
+          encode_edges_bdv(s.data(), d.data(), n, out.p, cap_bytes);
+      check(wrote <= cap_bytes, "encoder overran its declared worst case");
+    } else {
+      // sorter with hostile ids: must refuse, never index the tables
+      int64_t n = 1 + rng_below(512);
+      int32_t cap = 1 + (int32_t)rng_below(1 << 16);
+      std::vector<int32_t> s((size_t)n), d((size_t)n), os((size_t)n),
+          od((size_t)n);
+      for (int64_t i = 0; i < n; ++i) {
+        s[(size_t)i] = (int32_t)(rng() & 0x7FFFFFFF) - (int32_t)rng_below(4);
+        d[(size_t)i] = (int32_t)rng_below((uint32_t)cap);
+      }
+      int64_t rc =
+          sort_edges_dst_src(s.data(), d.data(), n, cap, os.data(), od.data());
+      check(rc == n || rc == -1, "sorter verdict out of taxonomy");
+    }
+  }
+  printf("fuzz ok (%" PRId64 " iters)\n", iters);
+  return 0;
+}
+
+uint32_t rd_u32le(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+int replay(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "replay: cannot open %s\n", path);
+    return 1;
+  }
+  std::vector<uint8_t> data;
+  uint8_t chunk[4096];
+  size_t r;
+  while ((r = fread(chunk, 1, sizeof(chunk), f)) > 0) {
+    data.insert(data.end(), chunk, chunk + r);
+  }
+  fclose(f);
+  if (data.size() < 16 || memcmp(data.data(), "GFZ1", 4) != 0) {
+    fprintf(stderr, "replay: %s is not a GFZ1 corpus file\n", path);
+    return 1;
+  }
+  uint8_t mode = data[4];
+  uint8_t code = data[5];
+  uint8_t sort = data[6];
+  uint32_t n = rd_u32le(&data[8]);
+  uint32_t cap = rd_u32le(&data[12]);
+  const uint8_t* payload = data.data() + 16;
+  int64_t payload_len = (int64_t)data.size() - 16;
+  if (mode == 1) {
+    if (n > (1u << 22)) {
+      fprintf(stderr, "replay: %s claims an absurd batch\n", path);
+      return 1;
+    }
+    run_decode(payload, payload_len, (int64_t)n, (int)code, (int32_t)cap,
+               (int32_t)sort);
+    printf("replay %s: decode done\n", path);
+    return 0;
+  }
+  if (mode == 2) {
+    if (payload_len < 12) {
+      fprintf(stderr, "replay: %s prefix under 12 bytes\n", path);
+      return 1;
+    }
+    ExactBuf p(12);
+    memcpy(p.p, payload, 12);
+    int64_t h = 0, pl = 0;
+    int32_t rc = gly1_probe_prefix(p.p, (int64_t)n, (int64_t)cap, &h, &pl);
+    check(rc == 0 || (rc >= -3 && rc < 0), "probe verdict out of taxonomy");
+    printf("replay %s: probe rc=%d\n", path, rc);
+    return 0;
+  }
+  fprintf(stderr, "replay: %s has unknown mode %u\n", path, mode);
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc >= 2 && strcmp(argv[1], "selfcheck") == 0) {
+    return selfcheck();
+  }
+  if (argc >= 4 && strcmp(argv[1], "fuzz") == 0) {
+    return fuzz(strtoull(argv[2], nullptr, 10), strtoll(argv[3], nullptr, 10));
+  }
+  if (argc >= 3 && strcmp(argv[1], "replay") == 0) {
+    int rc = 0;
+    for (int k = 2; k < argc; ++k) rc |= replay(argv[k]);
+    return rc;
+  }
+  fprintf(stderr,
+          "usage: %s selfcheck | fuzz <seed> <iters> | replay <file>...\n",
+          argv[0]);
+  return 2;
+}

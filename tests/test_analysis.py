@@ -49,7 +49,16 @@ def _package_paths():
     root = analysis.package_root()
     return [
         os.path.join(root, d)
-        for d in ("core", "io", "library", "ops", "parallel", "runtime", "utils")
+        for d in (
+            "core",
+            "io",
+            "library",
+            "native_src",
+            "ops",
+            "parallel",
+            "runtime",
+            "utils",
+        )
     ]
 
 
@@ -81,6 +90,7 @@ def test_cli_package_scan_exits_zero():
             "core",
             "io",
             "library",
+            "native_src",
             "ops",
             "parallel",
             "runtime",
@@ -95,7 +105,7 @@ def test_cli_package_scan_exits_zero():
 
 
 @pytest.mark.timeout_cap(120)
-def test_cli_list_passes_names_all_ten():
+def test_cli_list_passes_names_all_fourteen():
     proc = subprocess.run(
         [
             sys.executable,
@@ -119,6 +129,10 @@ def test_cli_list_passes_names_all_ten():
         "lock-order",
         "check-then-act",
         "test-discipline",
+        "native-leak",
+        "native-bound",
+        "native-ovfl",
+        "native-abi",
     ):
         assert name in proc.stdout
 
@@ -320,6 +334,273 @@ def test_corpus_decodepool():
     assert any("self._free" in f.message for f in findings)
     assert any("np.asarray" in f.message for f in findings)
     assert _analyze("good_decodepool.py") == []
+
+
+def test_corpus_native():
+    """The C++ decode-plane fixtures (ISSUE 15): all four nativecheck rule
+    families fire on their seeded defects — ctypes signature drift (arity,
+    width, unlisted export), an untrusted read before any bounds
+    comparison, narrow size arithmetic into malloc/memcpy, and a refusal
+    path that leaks — while the contract-honoring twin (with a justified
+    ``// graft: disable=`` suppression) scans clean."""
+    findings = _analyze("bad_native.cpp")
+    assert _codes(findings) == [
+        "NATIVEABI",
+        "NATIVEABI",
+        "NATIVEABI",
+        "NATIVEBOUND",
+        "NATIVELEAK",
+        "NATIVEOVFL",
+        "NATIVEOVFL",
+    ]
+    msgs = "\n".join(f.format() for f in findings)
+    assert "count_rows takes 2 parameter(s)" in msgs
+    assert "cc_baseline parameter 4" in msgs
+    assert "decode_probe has no declared ctypes signature" in msgs
+    assert "before any bounds comparison against nbytes" in msgs
+    assert "without free(tmp)" in msgs
+    assert "(size_t)n" in msgs
+    assert _analyze("good_native.cpp") == []
+
+
+def test_native_passes_only_see_cpp_and_vice_versa():
+    """Language routing: the Python passes must not choke on (or scan) a
+    .cpp file, and the native passes stay silent on .py sources — the same
+    seeded text produces PARSE/RAWJIT only under its own language."""
+    cpp_text = 'extern "C" int64_t mystery(const char* p) { return 0; }\n'
+    findings = analysis.analyze_source(cpp_text, "probe.cpp")
+    assert _codes(findings) == ["NATIVEABI"]  # and no PARSE from ast
+    py_text = "import jax\n\nstep = jax.jit(lambda x: x)\n"
+    findings = analysis.analyze_source(py_text, "probe.py")
+    assert _codes(findings) == ["RAWJIT"]  # and no NATIVE* from the lexer
+
+
+def test_cpp_suppression_grammar():
+    """``// graft: disable=CODE`` works trailing and standalone-above, is
+    code-specific, and does not leak to the next line — the Python
+    grammar's contract, ported."""
+    base = (
+        "int64_t probe_fn(int64_t n) {{\n"
+        "{}"
+        "  char* p = static_cast<char*>(malloc(n * 2));{}\n"
+        "  free(p);\n"
+        "  return n;\n"
+        "}}\n"
+    )
+    trailing = base.format(
+        "", "  // graft: disable=NATIVEOVFL — probe justification"
+    )
+    assert analysis.analyze_source(trailing, "probe.cpp") == []
+    above = base.format(
+        "  // graft: disable=NATIVEOVFL — standalone form\n", ""
+    )
+    assert analysis.analyze_source(above, "probe.cpp") == []
+    bare = base.format("", "")
+    assert _codes(analysis.analyze_source(bare, "probe.cpp")) == ["NATIVEOVFL"]
+    wrong = base.format("", "  // graft: disable=NATIVELEAK — wrong code")
+    assert _codes(analysis.analyze_source(wrong, "probe.cpp")) == ["NATIVEOVFL"]
+
+
+def test_native_leak_null_guard_is_name_exact():
+    """Regression: a failure guard for pointer ``ab`` must not exempt a
+    leak of pointer ``a`` (``!a`` is a substring of ``!ab``) — guard
+    matching is identifier-boundary-exact."""
+    leaky = """
+int64_t two_allocs(int64_t n) {
+  char* a = static_cast<char*>(malloc((size_t)n));
+  if (!a) return -1;
+  char* ab = static_cast<char*>(malloc((size_t)n));
+  if (!ab) return -2;
+  free(ab);
+  free(a);
+  return n;
+}
+"""
+    findings = analysis.analyze_source(leaky, "probe.cpp")
+    assert _codes(findings) == ["NATIVELEAK"]
+    assert "free(a)" in findings[0].message
+    fixed = leaky.replace(
+        "if (!ab) return -2;",
+        "if (!ab) {\n    free(a);\n    return -2;\n  }",
+    )
+    assert analysis.analyze_source(fixed, "probe.cpp") == []
+
+
+def test_native_leak_compound_guard_does_not_exempt():
+    """Regression: ``if (!p || other) return`` returns with p LIVE on the
+    other-branch — only a condition that pins p null in every disjunct
+    (e.g. ``!p`` alone, or ``!p && logging``) exempts the return."""
+    compound = """
+int64_t guard_probe(int64_t n, int32_t flag) {
+  char* p = static_cast<char*>(malloc((size_t)n));
+  if (!p || n > 100) return -1;
+  free(p);
+  return n;
+}
+"""
+    findings = analysis.analyze_source(compound, "probe.cpp")
+    assert _codes(findings) == ["NATIVELEAK"]
+    conjunct = compound.replace("if (!p || n > 100)", "if (!p && flag)")
+    assert analysis.analyze_source(conjunct, "probe.cpp") == []
+
+
+def test_suppression_grammars_do_not_cross_languages():
+    """Regression: a Python '#' comment that merely MENTIONS the C++
+    grammar (`// graft: disable=...`) must not suppress a Python finding,
+    and a C++ `//` comment mentioning the Python grammar must not
+    suppress a C++ one."""
+    py = (
+        "import jax\n\n"
+        "step = jax.jit(lambda x: x)  # C++ twin uses // graft: disable=RAWJIT\n"
+    )
+    assert _codes(_src(py)) == ["RAWJIT"]
+    cpp = (
+        "int64_t f(int64_t n) {\n"
+        "  char* p = static_cast<char*>(malloc(n * 2));  // py uses # graft: disable=NATIVEOVFL\n"
+        "  free(p);\n"
+        "  return n;\n"
+        "}\n"
+    )
+    assert _codes(analysis.analyze_source(cpp, "probe.cpp")) == ["NATIVEOVFL"]
+
+
+def test_native_bound_deref_compare_is_still_a_read():
+    """Regression: '*buf != 71' reads attacker memory just like buf[0];
+    only the exact NULL-test shapes (!buf, buf == nullptr) are exempt."""
+    deref = """
+// untrusted: buf[nbytes]
+int64_t probe(const uint8_t* buf, int64_t nbytes) {
+  if (*buf != 71) return -1;
+  return nbytes;
+}
+"""
+    assert _codes(analysis.analyze_source(deref, "probe.cpp")) == [
+        "NATIVEBOUND"
+    ]
+    nulltest = deref.replace("if (*buf != 71)", "if (buf == nullptr)")
+    assert analysis.analyze_source(nulltest, "probe.cpp") == []
+
+
+def test_native_ovfl_const_runtime_product_still_flags():
+    """Regression: 'const' on a narrow runtime product is not a constant —
+    only literal/known-constant initializers exempt a name, and a size_t
+    PARAMETER is already full-width (no cast demanded)."""
+    hidden = """
+int64_t probe(int32_t a, int32_t b) {
+  const int32_t total = a * b;
+  char* p = static_cast<char*>(malloc(total * 2));
+  free(p);
+  return total;
+}
+"""
+    assert _codes(analysis.analyze_source(hidden, "probe.cpp")) == [
+        "NATIVEOVFL"
+    ]
+    sizet_param = """
+int64_t grow(size_t n) {
+  char* p = static_cast<char*>(malloc(n * 2));
+  free(p);
+  return (int64_t)n;
+}
+"""
+    assert analysis.analyze_source(sizet_param, "probe.cpp") == []
+
+
+def test_native_abi_table_is_a_parseable_literal():
+    """NATIVEABI single-sources utils/native.py's NATIVE_SIGNATURES: the
+    table must parse as a pure literal (the analyzer never imports the
+    module) and carry every export of the canonical C++ source."""
+    from gelly_streaming_tpu.analysis import nativecheck
+
+    table = nativecheck.load_signature_table()
+    assert len(table) >= 15
+    canonical = os.path.join(
+        analysis.package_root(), "native_src", "edge_parser.cpp"
+    )
+    with open(canonical) as f:
+        funcs = nativecheck.parse_functions(nativecheck.lex(f.read()))
+    exports = {fn.name for fn in funcs if fn.extern_c}
+    assert exports  # the parser actually saw the extern "C" surface
+    assert exports <= set(table), exports - set(table)
+
+
+@pytest.mark.timeout_cap(120)
+def test_cli_json_carries_native_codes():
+    """--format json over the seeded C++ fixture: the machine schema rows
+    carry the C++ codes with correct file and integer line numbers."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "gelly_streaming_tpu.analysis",
+            "--format",
+            "json",
+            "--paths",
+            os.path.join(CORPUS, "bad_native.cpp"),
+            "--no-baseline",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    codes = sorted(r["code"] for r in data["findings"])
+    assert codes == [
+        "NATIVEABI",
+        "NATIVEABI",
+        "NATIVEABI",
+        "NATIVEBOUND",
+        "NATIVELEAK",
+        "NATIVEOVFL",
+        "NATIVEOVFL",
+    ]
+    for row in data["findings"]:
+        assert row["file"].endswith("bad_native.cpp")
+        assert isinstance(row["line"], int) and row["line"] > 0
+        assert row["pass"].startswith("native-")
+
+
+@pytest.mark.timeout_cap(180)
+def test_cli_parallel_jobs_handle_cpp():
+    """--jobs 2 agrees with the serial scan on a path set that mixes .py
+    and .cpp — the worker processes must route the C++ file through the
+    native passes exactly like the in-process scan."""
+    argv = [
+        sys.executable,
+        "-m",
+        "gelly_streaming_tpu.analysis",
+        "--paths",
+        os.path.join(CORPUS, "bad_native.cpp"),
+        os.path.join(CORPUS, "bad_rawjit.py"),
+        "--no-baseline",
+    ]
+    serial = subprocess.run(argv, capture_output=True, text=True, cwd=REPO_ROOT)
+    parallel = subprocess.run(
+        argv + ["--jobs", "2"], capture_output=True, text=True, cwd=REPO_ROOT
+    )
+    assert serial.returncode == parallel.returncode == 1
+    assert serial.stdout == parallel.stdout
+    assert "NATIVEABI" in serial.stdout and "RAWJIT" in serial.stdout
+
+
+def test_native_src_in_default_scan_paths():
+    """native_src/ must sit inside the default --paths set, so the package
+    gate (and the CLI default scan) covers the C++ byte path without
+    anyone remembering to add it."""
+    from gelly_streaming_tpu.analysis.__main__ import main as _cli_main  # noqa: F401
+    import gelly_streaming_tpu.analysis.__main__ as cli
+
+    src = open(cli.__file__).read()
+    assert '"native_src"' in src
+    canonical = os.path.join(
+        analysis.package_root(), "native_src", "edge_parser.cpp"
+    )
+    assert os.path.exists(canonical)
+    files = list(analysis.iter_source_files(
+        [os.path.join(analysis.package_root(), "native_src")]
+    ))
+    assert canonical in files
 
 
 def test_decode_pool_module_in_default_scan_paths():
@@ -883,7 +1164,7 @@ def test_syntax_error_is_a_parse_finding():
     assert _codes(findings) == ["PARSE"]
 
 
-def test_registry_has_ten_passes_in_order():
+def test_registry_has_fourteen_passes_in_order():
     passes = list(analysis.load_passes())
     assert passes == [
         "hot-loop",
@@ -896,6 +1177,10 @@ def test_registry_has_ten_passes_in_order():
         "lock-order",
         "check-then-act",
         "test-discipline",
+        "native-leak",
+        "native-bound",
+        "native-ovfl",
+        "native-abi",
     ]
 
 
