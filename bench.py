@@ -471,6 +471,135 @@ def _multi_tenant_bench(
             if k in ("job_records", "job_queue_full_skips")
         }
     )
+    out.update(_fused_dispatch_bench())
+    return out
+
+
+def _fused_dispatch_bench(windows: int = 64, win_edges: int = 256,
+                          capacity: int = 1 << 12):
+    """Cross-tenant fused dispatch quadrant (ISSUE 16): jobs in {1, 4, 16}
+    with ``cfg.fused_dispatch`` off/on.
+
+    Same-shape streaming-CC queries on the plain windowed plane (batch
+    misaligned to the window cut, so the wire fast path does not claim
+    them), small windows so per-dispatch overhead — the thing fused
+    cohorts amortize — dominates device compute.  All jobs are submitted
+    behind one shared ``ready`` gate and released together: per-job
+    finish-time skew then measures the scheduler's fairness, not
+    submission-order head start.  Sinks materialize only each job's final
+    state; intermediate window partials stay device-resident, as a
+    streaming consumer that reads the converged answer would leave them.
+
+    Reported per (jobs, mode): aggregate eps; plus the 16-job
+    fused-vs-solo speedup (the ISSUE 16 headline), 16-job fused fairness,
+    bit-exact parity of every job's final component labels between the
+    fused and solo planes, and the retrace guard across 1 -> 16 tenancy
+    (pow2 row buckets: 0 compiles after warmup).
+    """
+    import dataclasses
+    import threading
+
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core import compile_cache
+    from gelly_streaming_tpu.core.config import RuntimeConfig, StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.connected_components import (
+        ConnectedComponents,
+    )
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.utils import metrics
+
+    n = windows * win_edges
+    cfg_solo = StreamConfig(
+        vertex_capacity=capacity,
+        batch_size=(win_edges // 2) + 32,  # misaligned: windowed plane
+        ingest_window_edges=win_edges,
+        fused_dispatch=0,
+    )
+    cfg_fused = dataclasses.replace(cfg_solo, fused_dispatch=1)
+    rng = np.random.default_rng(16)
+    datasets = [
+        (
+            rng.integers(0, capacity, n).astype(np.int32),
+            rng.integers(0, capacity, n).astype(np.int32),
+        )
+        for _ in range(16)
+    ]
+
+    def run(n_jobs, cfg):
+        finish = {}
+        finals = {}
+        seen = [0] * n_jobs
+        release = threading.Event()
+        with JobManager(
+            RuntimeConfig(max_jobs=16, fair_quantum=4)
+        ) as manager:
+            for i in range(n_jobs):
+                def sink(rec, i=i):
+                    seen[i] += 1
+                    if seen[i] == windows:
+                        finals[i] = np.asarray(rec[0].parent)
+                        finish[i] = time.perf_counter()
+
+                manager.submit_aggregation(
+                    EdgeStream.from_arrays(*datasets[i], cfg),
+                    ConnectedComponents(),
+                    name=f"fd-{cfg.fused_dispatch}-{n_jobs}x-{i}",
+                    sink=sink,
+                    ready=release.is_set,
+                )
+            t0 = time.perf_counter()
+            release.set()
+            manager.poke()
+            manager.wait_all()
+        wall = time.perf_counter() - t0
+        per_job_eps = [n / (finish[i] - t0) for i in range(n_jobs)]
+        return (
+            n_jobs * n / wall,
+            min(per_job_eps) / max(per_job_eps),
+            [finals[i] for i in range(n_jobs)],
+        )
+
+    # warmup: one solo-plane and one fused-plane job land the per-cfg
+    # executables, then every pow2 row bucket lands its mega-fold +
+    # cohort-split pair, so the sweep below must retrace nothing
+    run(1, cfg_solo)
+    run(1, cfg_fused)
+    cc = ConnectedComponents()
+    fold = cc._superpane_fold_fn(cfg_fused, False)
+    for rows in (2, 4, 8, 16):
+        states = fold(
+            jnp.zeros((rows, win_edges), jnp.int32),
+            jnp.zeros((rows, win_edges), jnp.int32),
+            None,
+            jnp.zeros((rows, win_edges), bool),
+        )
+        cc._superpane_split_fn(cfg_fused, rows)(states)
+    compile_cache.reset_stats()
+    metrics.reset_fused_dispatch_stats()
+
+    out = {}
+    finals = {}
+    for n_jobs in (1, 4, 16):
+        solo_eps, _, solo_finals = run(n_jobs, cfg_solo)
+        fused_eps, fused_fair, fused_finals = run(n_jobs, cfg_fused)
+        out[f"fused_off_agg_eps_{n_jobs}"] = round(solo_eps, 1)
+        out[f"fused_agg_eps_{n_jobs}"] = round(fused_eps, 1)
+        finals[n_jobs] = (solo_finals, fused_finals)
+        if n_jobs == 16:
+            out["fused_vs_solo_speedup"] = round(fused_eps / solo_eps, 3)
+            out["fairness_min_max_fused"] = round(fused_fair, 3)
+    out["fused_parity_ok"] = int(
+        all(
+            np.array_equal(s, f)
+            for solo_finals, fused_finals in finals.values()
+            for s, f in zip(solo_finals, fused_finals)
+        )
+    )
+    out["fused_recompiles_after_warm"] = compile_cache.stats()["recompiles"]
+    out["fused_compiles_after_warm"] = compile_cache.stats()["compiles"]
+    out.update(metrics.fused_dispatch_stats())
     return out
 
 
@@ -905,6 +1034,11 @@ _HIGHER_KEYS = {
     # `_ratio_4` evades the `_ratio` suffix rule, and this figure is the
     # ROADMAP item-1 target the regression gate must hold
     "serving_vs_inprocess_ratio_4",
+    # ISSUE 16 fused-dispatch headlines: the job-count suffix evades the
+    # `_eps` rule, and fairness/parity carry no classified suffix at all
+    "fused_agg_eps_16",
+    "fairness_min_max_fused",
+    "fused_parity_ok",
 }
 _HIGHER_SUFFIXES = (
     "_eps",
@@ -1692,6 +1826,7 @@ def main():
     # ---- multi-tenant job runtime: jobs in {1, 2, 4} over one pipeline -----
     # (ISSUE 5 acceptance: 4 same-shape jobs at >= 0.8x the single-job
     # baseline with 0 recompiles after warmup and near-1.0 fairness)
+    mt_stats = {}
     try:
         if os.environ.get("GELLY_BENCH_MULTITENANT", "1") != "0":
             mt_stats = _multi_tenant_bench(
@@ -1712,12 +1847,26 @@ def main():
                 f"recompiles {mt_stats['multi_tenant_recompiles']}",
                 file=sys.stderr,
             )
+            print(
+                f"fused dispatch: 16 jobs "
+                f"{mt_stats['fused_off_agg_eps_16'] / 1e3:.0f}K eps solo vs "
+                f"{mt_stats['fused_agg_eps_16'] / 1e3:.0f}K eps fused "
+                f"(x{mt_stats['fused_vs_solo_speedup']}), fairness "
+                f"{mt_stats['fairness_min_max_fused']}, parity "
+                f"{mt_stats['fused_parity_ok']}, cohort mean "
+                f"{mt_stats['fused_jobs_per_dispatch_mean']} hwm "
+                f"{mt_stats['fused_jobs_per_dispatch_hwm']}, recompiles "
+                f"{mt_stats['fused_recompiles_after_warm']} compiles "
+                f"{mt_stats['fused_compiles_after_warm']}",
+                file=sys.stderr,
+            )
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"multi-tenant bench skipped: {e}", file=sys.stderr)
 
     # ---- streaming RPC serving plane: clients in {1, 4, 16} over loopback --
     # (ISSUE 8 acceptance: connection-scaling eps and p50/p99
     # submit-to-first-emission latency, plus the server-vs-in-process ratio)
+    serving_stats = {}
     try:
         if os.environ.get("GELLY_BENCH_SERVING", "1") != "0":
             serving_stats = _serving_bench(
@@ -1752,6 +1901,7 @@ def main():
     # (ISSUE 11 acceptance: the drain->first-emission gap a tenant sees
     # across a 1 -> 2 shard rescale, the steady post-rescale rate, and the
     # exact non-idempotent counts across it)
+    rescale_stats = {}
     try:
         if os.environ.get("GELLY_BENCH_RESCALE", "1") != "0":
             rescale_stats = _rescale_bench(
@@ -2234,6 +2384,13 @@ def main():
                 **cache_guard,
                 **async_stats,
                 **binned_stats,
+                # the job-runtime planes were _PARTIAL-only before ISSUE 16:
+                # a normal completion DROPPED the multi-tenant / fused /
+                # serving / rescale keys from the artifact, so their
+                # regression gates only ever saw watchdog dumps
+                **mt_stats,
+                **serving_stats,
+                **rescale_stats,
                 **analysis_stats,
                 **comms_stats,
                 # re-read at exit: the headline drive's wire streams ship

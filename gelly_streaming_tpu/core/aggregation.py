@@ -17,6 +17,7 @@ operator state is not checkpointed (SURVEY.md §5.3-4).
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Any, Callable, Iterator, Optional
@@ -28,7 +29,11 @@ import numpy as np
 from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream
-from gelly_streaming_tpu.core.windows import WindowPane, stream_panes
+from gelly_streaming_tpu.core.windows import (
+    FoldRequest,
+    WindowPane,
+    stream_panes,
+)
 from gelly_streaming_tpu.utils import metrics, tracing
 
 
@@ -41,6 +46,16 @@ def _tree_copy(tree):
     the invariant async snapshots rely on.
     """
     return jax.tree.map(jnp.copy, tree)
+
+
+def resolve_fused_dispatch(cfg: StreamConfig) -> bool:
+    """Cross-tenant fused dispatch on/off: ``cfg.fused_dispatch`` forces,
+    -1 defers to GELLY_FUSED_DISPATCH (default OFF — solo dispatch is the
+    equivalence oracle, and fusing adds one superpane-executable compile
+    that cold single-tenant paths should not pay)."""
+    from gelly_streaming_tpu.utils.envswitch import resolve_switch
+
+    return resolve_switch(cfg.fused_dispatch, "GELLY_FUSED_DISPATCH", False)
 
 
 class SummaryAggregation:
@@ -1012,6 +1027,187 @@ class SummaryAggregation:
 
         return OutputStream(records)
 
+    # -- cross-tenant fused dispatch (runtime/manager.py cohorts) -------------
+
+    def fused_eligible(self, stream) -> bool:
+        """True when this descriptor/stream pair would ride the plain
+        single-partition synchronous windowed plane — the only plane the
+        cross-tenant fused protocol replaces.  Wire, mesh-wire, sharded,
+        superbatch, and async jobs keep their own (already-batched or
+        already-pipelined) planes and simply dispatch solo under a fused
+        manager."""
+        cfg = stream.cfg
+        if self._wire_eligible(stream) or self._mesh_wire_eligible(stream):
+            return False
+        if cfg.num_shards > 1 and cfg.num_shards <= len(jax.devices()):
+            return False
+        if self._num_partitions(cfg) != 1:
+            return False
+        if cfg.superbatch > 1:
+            return False
+        from gelly_streaming_tpu.core import async_exec
+
+        return async_exec.resolve_depth(cfg) == 0
+
+    def run_fused(
+        self,
+        stream,
+        checkpoint_path: Optional[str] = None,
+        restore: bool = True,
+    ) -> Iterator[tuple]:
+        """The windowed plane as a fused-dispatch COHORT MEMBER: a
+        bidirectional generator that parks each window's padded fold at a
+        ``FoldRequest`` yield instead of dispatching it.
+
+        The consumer (the manager's scheduler) ``send()``s back either a
+        fused per-row partial — its row of one vmapped mega-fold over N
+        tenant jobs' same-key requests — or ``None``, which makes the
+        generator fold the SAME padded arrays itself through the same
+        executable chain as the plain plane (the bit-exact solo oracle).
+        A consumer that does not understand the protocol resumes with
+        plain ``next()`` — Python defines that as ``send(None)`` — so a
+        dropped/parked quantum, a paused-then-resumed job, or a naive
+        iterator consumer all degrade to correct solo dispatch rather
+        than losing the window.  Everything downstream of the fold
+        (running merge order, transform, at-least-once emission,
+        positional checkpoints, transient resets) is ``_merge_loop``'s
+        logic verbatim, so fused and solo record sequences are
+        bit-identical (pinned by tests/test_fused_dispatch.py).
+        """
+        if checkpoint_path and stream.cfg.ingest_window_ms:
+            raise ValueError(
+                "wall-clock ingestion panes (ingest_window_ms) are not "
+                "replay-deterministic: a resume would skip panes by id that "
+                "cover different edges than the crashed run's; use "
+                "ingest_window_edges for checkpointed runs"
+            )
+        return self._fused_pane_records(stream, checkpoint_path, restore)
+
+    def _fused_pane_records(
+        self,
+        stream,
+        checkpoint_path: Optional[str],
+        restore: bool,
+    ) -> Iterator[tuple]:
+        """Merger loop with the per-pane fold handed to the cohort consumer
+        (see ``run_fused``).  Mirrors ``_merge_loop`` + the sync
+        ``fold_pane`` exactly; any drift here is a correctness bug, not a
+        style one."""
+        cfg = stream.cfg
+        window_ms = self.window_ms or cfg.window_ms
+        running = None
+        start_after = -1
+        global_done = False
+        if checkpoint_path and restore:
+            from gelly_streaming_tpu.utils.checkpoint import (
+                checkpoint_exists,
+                load_state,
+            )
+
+            if checkpoint_exists(checkpoint_path):
+                try:
+                    snap = load_state(checkpoint_path, self._checkpoint_like(cfg))
+                    if bool(snap["has_summary"]):
+                        running = snap["summary"]
+                    start_after = int(snap["last_window"])
+                    global_done = bool(snap["global_done"])
+                except ValueError:
+                    # legacy snapshot layout: a bare summary pytree with
+                    # no stream position (pre-position checkpoints)
+                    running = load_state(checkpoint_path, self.initial_state(cfg))
+        span_sampler = tracing.sampler(cfg, "merge")
+        token = self.cache_token
+        split = functools.partial(self._superpane_split_fn, cfg)
+        for pane in stream_panes(stream, window_ms):
+            already_folded = (0 <= pane.window_id <= start_after) or (
+                pane.window_id == -1 and global_done
+            )
+            if already_folded:
+                continue  # folded before the snapshot: replay-safe
+            span = (
+                span_sampler.begin(pane.window_id)
+                if span_sampler is not None
+                else None
+            )
+            t_item = time.perf_counter()
+            pane = self._maybe_bin_pane(cfg, pane)
+            n = pane.num_edges
+            if n == 0:
+                continue  # empty pane: the sync fold returns None too
+            # the sync plane's pow2 pad, materialized as the offered row
+            e_pad = max(1, 1 << (n - 1).bit_length())
+            src = np.zeros((e_pad,), np.int32)
+            dst = np.zeros((e_pad,), np.int32)
+            msk = np.zeros((e_pad,), bool)
+            src[:n], dst[:n], msk[:n] = pane.src, pane.dst, True
+            val = None
+            if pane.val is not None:
+
+                def pad(a):
+                    out = np.zeros((e_pad,) + a.shape[1:], a.dtype)
+                    out[:n] = a
+                    return out
+
+                val = jax.tree.map(pad, pane.val)
+            has_val = val is not None
+            partial = yield FoldRequest(
+                key=(token, cfg, has_val, e_pad),
+                fold=self._superpane_fold_fn(cfg, has_val),
+                split=split,
+                src=src,
+                dst=dst,
+                val=val,
+                mask=msk,
+                window_id=pane.window_id,
+                edges=n,
+            )
+            if partial is None:
+                # solo fallback: no same-key peers this round (or a
+                # protocol-naive resume) — fold the identical padded
+                # arrays through the plain plane's executable
+                partial = self._update_j(
+                    self.initial_state(cfg),
+                    jnp.asarray(src),
+                    jnp.asarray(dst),
+                    None if val is None else jax.tree.map(jnp.asarray, val),
+                    jnp.asarray(msk),
+                )
+            if running is None or self.transient_state:
+                running = partial
+            else:
+                running = self._combine_j(running, partial)
+            out = self.transform(running)
+            t_emit = time.perf_counter()
+            metrics.hist_record(
+                "window_close_to_emission_ms", (t_emit - t_item) * 1e3
+            )
+            if span is not None:
+                span.mark("dispatch", t_item, t_emit)
+                span.mark("emit", t_emit)
+                span_sampler.record(span, t_emit)
+            # Emit BEFORE snapshotting: a crash between the two re-emits
+            # this window on recovery (at-least-once emission) instead of
+            # dropping it (at-most-once would lose sink data).
+            yield out if isinstance(out, tuple) else (out,)
+            start_after = max(pane.window_id, start_after)
+            global_done = global_done or pane.window_id == -1
+            if checkpoint_path:
+                from gelly_streaming_tpu.utils.checkpoint import save_state
+
+                # transient aggregations reset after emission, so a
+                # restore must come back with no running summary
+                save_state(
+                    checkpoint_path,
+                    {
+                        "summary": running,
+                        "has_summary": np.full((), not self.transient_state, bool),
+                        "last_window": np.full((), start_after, np.int64),
+                        "global_done": np.full((), global_done, bool),
+                    },
+                )
+            if self.transient_state:
+                running = None
+
     def _restored_position(self, cfg, checkpoint_path, restore):
         """(last folded window id, global pane done) from a windowed-layout
         snapshot — for gating pane prefetch/fold work ahead of the merge
@@ -1180,6 +1376,31 @@ class SummaryAggregation:
 
         return compile_cache.cached_jit(
             ("superpane_fold", token, cfg, has_val), make
+        )
+
+    def _superpane_split_fn(self, cfg: StreamConfig, rows: int):
+        """Compiled cohort drain: ONE dispatch slices a ``[rows, ...]``
+        stacked mega-fold result into per-row partial pytrees (row i =
+        job i's window partial, still on device).
+
+        Draining with an eager per-row ``a[i]`` slice instead costs one
+        device dispatch per job per cohort — measured ~2x the fused fold
+        itself at 16 rows — which would hand back most of the dispatch
+        amortization the mega-fold just bought.  Keyed per pow2 row
+        bucket, so 1..16-job tenancy reuses at most four traces."""
+        token = self.cache_token
+
+        def make():
+            def split(states):
+                return tuple(
+                    jax.tree.map(lambda a, i=i: a[i], states)
+                    for i in range(rows)
+                )
+
+            return split
+
+        return compile_cache.cached_jit(
+            ("superpane_split", token, cfg, rows), make
         )
 
     def _superpane_folds(

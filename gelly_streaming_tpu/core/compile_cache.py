@@ -262,6 +262,24 @@ def stats() -> dict:
         }
 
 
+def label_stats(label: Any) -> dict:
+    """Per-kernel-family counters: every live entry whose ``label`` matches,
+    summed.  The fused-dispatch plane uses this to report the shared
+    superpane executable's call/compile economy separately from the
+    process-wide totals (one cohort dispatch = one ``calls`` tick here,
+    however many tenant rows it folded)."""
+    with _LOCK:
+        entries = [e for e in _ENTRIES.values() if e.label == label]
+        return {
+            "entries": len(entries),
+            "calls": sum(e.calls for e in entries),
+            "compiles": sum(e.compiles for e in entries),
+            "compile_time_s": round(
+                sum(e.compile_time_s for e in entries), 4
+            ),
+        }
+
+
 def reset_stats() -> None:
     """Zero the counters (entries and their executables stay cached)."""
     global _KEY_HITS, _KEY_MISSES, _COMPILES, _COMPILE_TIME_S, _DISPATCH_HITS

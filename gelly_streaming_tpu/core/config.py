@@ -115,6 +115,18 @@ class StreamConfig:
     # vertex_capacity <= 2^28.  1 = on, 0 = off (the plain fixed-width
     # oracle), -1 = defer to GELLY_WIRE_COMPRESS (default off).
     wire_compress: int = -1
+    # Cross-tenant fused dispatch (runtime/manager.py): under a JobManager,
+    # same-shape ready windows from N tenant jobs stack into ONE vmapped
+    # mega-fold through the shared superpane executable
+    # (core/aggregation.py `_superpane_fold_fn`) instead of N solo
+    # dispatches — the superbatch row-per-window layout generalized across
+    # jobs.  Applies to the single-partition windowed pane plane only;
+    # wire/async/superbatch/sharded jobs keep their own planes.  1 = on,
+    # 0 = off (per-job solo dispatch, the bit-exact equivalence oracle),
+    # -1 = defer to the GELLY_FUSED_DISPATCH env var (default off).
+    # Emission order, fairness accounting, checkpoints, and record bytes
+    # are identical either way (pinned by tests/test_fused_dispatch.py).
+    fused_dispatch: int = -1
     # Per-window span tracing (utils/tracing.py): sample rate in (0, 1]
     # for the flight-recorder spans that time each window across
     # pack -> transfer -> dispatch -> drain -> emit.  0 = off (the
@@ -170,6 +182,8 @@ class StreamConfig:
             raise ValueError("binned_ingest must be -1 (auto), 0, or 1")
         if self.wire_compress not in (-1, 0, 1):
             raise ValueError("wire_compress must be -1 (auto), 0, or 1")
+        if self.fused_dispatch not in (-1, 0, 1):
+            raise ValueError("fused_dispatch must be -1 (auto), 0, or 1")
         if self.wire_compress == 1 and self.binned_ingest == 0:
             raise ValueError(
                 "wire_compress=1 needs binned batches (delta encoding rides "

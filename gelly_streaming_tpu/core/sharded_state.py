@@ -34,6 +34,16 @@ identity (so empty shards and restores need no masking), and
 ``combine(a, update(initial, e)) == update(a, e)`` must hold (running folds
 continue in place instead of re-merging per-pane partials) — true of the
 union-find and additive summaries this plane serves.
+
+Relation to CROSS-TENANT fused dispatch (``cfg.fused_dispatch``,
+runtime/manager.py): the two batching axes are mutually exclusive by
+construction.  This plane shards ONE job's summary state over S devices;
+the fused plane stacks N single-partition jobs' per-window partials along
+a batch axis of one device dispatch — each tenant's summary-state row
+stays wholly its own (per-job combine/transform/checkpoint, no cross-job
+state), which is why ``fused_eligible`` refuses sharded configs and a
+``num_shards > 1`` job under a fused manager simply keeps this plane and
+dispatches solo.
 """
 
 from __future__ import annotations

@@ -465,6 +465,65 @@ def pad_pane_edges(pane: WindowPane):
     return src, dst, msk
 
 
+class FoldRequest(NamedTuple):
+    """One job's parked window fold, offered to a cross-tenant cohort.
+
+    The fused-dispatch handshake record (core/aggregation.py
+    ``_fused_pane_records`` yields these; runtime/manager.py collects them):
+    ``key`` identifies the shared executable + padded shape, so requests with
+    equal keys from different jobs can stack into one vmapped mega-fold.
+    The arrays are already pow2-padded host arrays of length ``e_pad`` —
+    exactly the per-row layout of the superbatch plane — and ``fold`` is the
+    process-global cached executable (one per key, not per job).  A consumer
+    that does not understand the protocol simply ``next()``s past the yield,
+    which the generator treats as "no fused partial" and solo-folds: the
+    bit-exact fallback oracle.
+    """
+
+    key: tuple  # (cache_token, cfg, has_val, e_pad) — cohort compatibility
+    fold: object  # the shared superpane fold executable (compile_cache entry)
+    split: object  # rows -> the shared cohort-drain executable (one dispatch
+    #   slices the stacked result into per-row partials; eager per-row
+    #   slicing would cost one device call per job and undo the amortization)
+    src: np.ndarray  # int32 [e_pad]
+    dst: np.ndarray  # int32 [e_pad]
+    val: Optional[object]  # pytree of [e_pad]-padded arrays, or None
+    mask: np.ndarray  # bool [e_pad]; True on the first ``edges`` slots
+    window_id: int
+    edges: int  # true (unpadded) edge count
+
+
+def stack_fold_rows(requests):
+    """Stack N same-key FoldRequests into the [rows, e_pad] superpane layout.
+
+    ``rows`` is pow2-bucketed over the cohort size so varying tenancy
+    (1..16 jobs per dispatch) reuses one compiled executable; padding rows
+    are all-masked-out zeros, which every SummaryAggregation update ignores
+    by contract.  Returns ``(src, dst, val, mask, pad_rows)`` host arrays
+    ready for the shared superpane fold.
+    """
+    n = len(requests)
+    e_pad = requests[0].src.shape[0]
+    rows = max(1, 1 << (n - 1).bit_length())
+    src = np.zeros((rows, e_pad), np.int32)
+    dst = np.zeros((rows, e_pad), np.int32)
+    msk = np.zeros((rows, e_pad), bool)
+    for i, req in enumerate(requests):
+        src[i], dst[i], msk[i] = req.src, req.dst, req.mask
+    val = None
+    if requests[0].val is not None:
+        import jax
+
+        def _stack(*leaves):
+            out = np.zeros((rows,) + leaves[0].shape, leaves[0].dtype)
+            for i, leaf in enumerate(leaves):
+                out[i] = leaf
+            return out
+
+        val = jax.tree.map(_stack, *[req.val for req in requests])
+    return src, dst, val, msk, rows - n
+
+
 def validate_slide(window_ms: int, slide_ms: Optional[int]) -> None:
     """Eager check of a sliding-window spec (shared by every slide entry
     point so the contract cannot diverge)."""
@@ -501,6 +560,35 @@ def windowed_panes(
     return stream_panes(stream, window_ms)
 
 
+def _array_backed_panes(
+    src: np.ndarray, dst: np.ndarray, every_edges: int
+) -> Iterator[WindowPane]:
+    """Count-cut ingestion panes sliced straight off an array-backed
+    stream's host arrays.
+
+    Pane-content-identical to routing the stream's padded micro-batches
+    through ``assign_ingestion_windows``: ``EdgeStream.from_arrays``
+    chunks the SAME arrays contiguously (only the final chunk carries
+    masked padding, which ``_batch_to_host`` drops), so count cuts land on
+    the same edges in the same order — minus the per-batch device
+    EdgeBatch construction and mask readback, which dominated the windowed
+    plane's host time for array sources.  Array-backed streams are untimed
+    and value-less by construction, so panes carry ``max_timestamp=-1``
+    and ``val=time=None``.  Yields VIEWS of the caller's arrays — the same
+    backing-store contract the packed-wire path already has."""
+    n = len(src)
+    for wid in range((n + every_edges - 1) // every_edges):
+        lo = wid * every_edges
+        yield WindowPane(
+            wid,
+            -1,
+            src[lo : lo + every_edges],
+            dst[lo : lo + every_edges],
+            None,
+            None,
+        )
+
+
 def stream_panes(stream, window_ms: int) -> Iterator[WindowPane]:
     """The pane source for an aggregation over ``stream``: ingestion-time
     panes when the config asks for them, else event-time tumbling windows
@@ -509,6 +597,20 @@ def stream_panes(stream, window_ms: int) -> Iterator[WindowPane]:
     plane cannot diverge between execution paths."""
     cfg = stream.cfg
     if cfg.ingest_window_edges or cfg.ingest_window_ms:
+        arrays = getattr(stream, "_wire_arrays", None)
+        if (
+            cfg.ingest_window_edges
+            and arrays is not None
+            and not getattr(stream, "_stages", ())
+        ):
+            # count-cut panes over an untransformed array-backed stream
+            # slice straight off the backing host arrays: the micro-batch
+            # route chunks those same arrays, round-trips each chunk
+            # through a device EdgeBatch, and reads it back — identical
+            # pane content, one device round trip per batch more expensive
+            return _array_backed_panes(
+                arrays[0], arrays[1], cfg.ingest_window_edges
+            )
         return assign_ingestion_windows(
             stream.batches(),
             cfg.ingest_window_edges,

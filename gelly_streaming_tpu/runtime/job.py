@@ -20,7 +20,12 @@ replay from the snapshot), and a lifecycle state machine:
 * **PENDING** — admitted, not yet scheduled.
 * **RUNNING** — the scheduler pulls the job's iterator in weighted-fair
   rounds; each pull dispatches that job's next window through the shared
-  device pipeline.
+  device pipeline.  Under cross-tenant fused dispatch
+  (``cfg.fused_dispatch``) a pull may instead PARK at a ``FoldRequest``:
+  the scheduler stacks same-shape parked windows from other tenants into
+  one vmapped mega-fold and resumes each job with its own row — one
+  emission still costs one pull credit, so weighted fairness is
+  unchanged (see runtime/manager.py ``_dispatch_cohorts``).
 * **PAUSED** — the iterator is left SUSPENDED in place (its in-flight
   windows stay queued, its checkpoint keeps the last saved position);
   ``resume`` continues pulling exactly where it stopped, so in-process
@@ -178,6 +183,12 @@ class Job:
         # dump attached on a FAILED transition for post-mortems
         self._submit_t = time.perf_counter()
         self._first_emitted = False  # single-thread: scheduler
+        # windows this job contributed to cross-tenant fused dispatches
+        # (runtime/manager.py cohorts over the FoldRequest leg of
+        # ``run_fused``): bumped by the scheduler's cohort pass, read by
+        # status() from API threads — hence lock-guarded, not
+        # scheduler-private like the iterator bookkeeping above
+        self._fused_windows = 0  # guarded-by: _lock
         self._last_quantum_end: Optional[float] = None  # single-thread: scheduler
         self._trace_dump: Optional[List[dict]] = None  # guarded-by: _lock
 

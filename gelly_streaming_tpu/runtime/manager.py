@@ -51,6 +51,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 # lock-order: manager._lock < metrics._HIST_LOCK
 
 from gelly_streaming_tpu.core.config import RuntimeConfig
+from gelly_streaming_tpu.core.windows import FoldRequest, stack_fold_rows
 from gelly_streaming_tpu.runtime.job import (
     _SENTINEL,
     AdmissionError,
@@ -58,6 +59,35 @@ from gelly_streaming_tpu.runtime.job import (
     JobState,
 )
 from gelly_streaming_tpu.utils import events, metrics, tracing
+
+# distinguishes "initiate a fresh pull" from "resume a parked FoldRequest
+# with this fused partial" (which may legitimately be None — the solo
+# fallback) in the scheduler's pull loop
+_FRESH = object()
+
+
+class _Quantum:
+    """One job's in-flight weighted-fair round, parkable mid-pull.
+
+    The fused-dispatch continuation: when a job's iterator yields a
+    ``FoldRequest`` instead of a record, its quantum parks here — credits
+    spent so far, the rolling dispatch clock, and the parked request — so
+    the scheduler can collect same-key requests from OTHER jobs' quanta
+    into one cohort before resuming each with its row of the mega-fold.
+    Touched by the one scheduler thread only; lives for one round.
+    """
+
+    __slots__ = ("job", "credits", "pulled", "t_round", "t_prev", "request")
+
+    def __init__(self, job: Job, credits: int, t_round: float):
+        self.job = job
+        self.credits = credits
+        self.pulled = 0
+        self.t_round = t_round
+        # rolling dispatch clock (one perf_counter read per record, not
+        # two: each record's dispatch_s spans from the previous read)
+        self.t_prev = t_round
+        self.request: Optional[FoldRequest] = None
 
 
 class JobManager:
@@ -269,22 +299,50 @@ class JobManager:
         sink: Optional[Callable] = None,
         weight: int = 1,
         checkpoint_path: Optional[str] = None,
+        ready: Optional[Callable[[], bool]] = None,
     ) -> Job:
         """Submit ``descriptor.run(stream)`` as a job — the entry point that
         turns the aggregation runtime's loops into schedulable work.
+
+        ``ready`` passes through to :meth:`submit` (the source-readiness
+        gate); a shared gate also coordinates starts — submit N jobs with
+        ``ready=event.is_set`` and flip the event once, and the cohort
+        enters the scheduler in the same round with no submission-order
+        head start (how the fairness bench isolates scheduling from
+        submission stagger).
 
         State bytes come from ``descriptor.state_nbytes(stream.cfg)``;
         per-record edge accounting from the stream's ingestion-pane size
         when the source pins one (each emission covers one closed pane);
         the total-edge progress hint from ``stream.num_edges_hint()``.
+
+        With fused dispatch resolved on (``cfg.fused_dispatch`` /
+        GELLY_FUSED_DISPATCH) and the job on the plain windowed plane,
+        the build produces the descriptor's cohort-member generator
+        (``run_fused``) so this job's windows can stack into cross-tenant
+        mega-folds; every other plane — and fused-off — keeps the exact
+        ``descriptor.run`` path, which stays the equivalence oracle.
         """
+        from gelly_streaming_tpu.core import aggregation
+
         cfg = stream.cfg
         state_bytes = descriptor.state_nbytes(cfg)
         edges_per_record = cfg.ingest_window_edges or 0
-        return self.submit(
-            lambda: iter(
+        eligible = getattr(descriptor, "fused_eligible", None)
+        if (
+            aggregation.resolve_fused_dispatch(cfg)
+            and eligible is not None
+            and eligible(stream)
+        ):
+            build = lambda: descriptor.run_fused(
+                stream, checkpoint_path=checkpoint_path
+            )
+        else:
+            build = lambda: iter(
                 descriptor.run(stream, checkpoint_path=checkpoint_path)
-            ),
+            )
+        return self.submit(
+            build,
             name=name,
             sink=sink,
             weight=weight,
@@ -292,6 +350,7 @@ class JobManager:
             state_bytes=state_bytes,
             edges_per_record=edges_per_record,
             edges_hint=stream.num_edges_hint(),
+            ready=ready,
         )
 
     # -- rescale budget swap (the elastic control plane, ISSUE 11) -----------
@@ -471,12 +530,16 @@ class JobManager:
             dumps = {
                 job_id: job._trace_dump for job_id, job in jobs.items()
             }
+            fused = {
+                job_id: job._fused_windows for job_id, job in jobs.items()
+            }
         out = {}
         for job_id, job in jobs.items():
             row = {
                 "state": job.state,
                 "weight": job.weight,
                 "queue_depth": job.queue_depth,
+                "fused_windows": fused[job_id],
                 "state_bytes": job.state_bytes,
                 "edges_hint": job.edges_hint,
                 "checkpoint_path": job.checkpoint_path,
@@ -689,11 +752,19 @@ class JobManager:
                     return
                 jobs = list(self._jobs.values())
             progressed = False
+            # quanta parked at a FoldRequest this round, awaiting a cohort
+            pending: List[_Quantum] = []  # single-thread: scheduler
             for job in jobs:
                 try:
-                    progressed |= self._run_quantum(job)
+                    progressed |= self._run_quantum(job, pending)
                 except BaseException as e:  # defensive: never kill the loop
                     self._fail(job, e)
+            # cross-tenant fused dispatch: every parked window collected
+            # above now folds — same-key cohorts in ONE vmapped dispatch,
+            # loners solo — and each quantum resumes its remaining credits
+            # (which may park again, so cycles repeat until no job is
+            # parked; per-round work stays bounded by the credit budget)
+            progressed |= self._dispatch_cohorts(pending)
             if self._health_every:
                 # the health plane's sampling point: BETWEEN rounds on the
                 # one scheduler thread, reading host-side Python counters
@@ -716,8 +787,17 @@ class JobManager:
                 self._wake.wait(0.05)
                 self._wake.clear()
 
-    def _run_quantum(self, job: Job) -> bool:  # single-thread: scheduler
-        """One weighted-fair round for one job; True if it made progress."""
+    def _run_quantum(
+        self, job: Job, collect: "List[_Quantum]"
+    ) -> bool:  # single-thread: scheduler
+        """One weighted-fair round for one job; True if it made progress.
+
+        A job whose iterator parks at a ``FoldRequest`` lands its quantum
+        in ``collect`` for the round's cohort dispatch (``_dispatch_cohorts``)
+        instead of completing here — the quantum's credits carry over to
+        the resume, so fairness accounting is identical either way (one
+        emission = one credit, fused or solo).
+        """
         with self._lock:
             cancel_now = job._cancel_requested and not job._state_in(
                 *JobState.TERMINAL
@@ -749,52 +829,104 @@ class JobManager:
                 self._fail(job, e)
                 return True
         t_round = time.perf_counter()
-        credits = job.weight * self.cfg.fair_quantum
-        pulled = 0
+        q = _Quantum(job, job.weight * self.cfg.fair_quantum, t_round)
+        return self._pull_loop(q, collect, _FRESH)
+
+    def _pull_loop(
+        self, q: "_Quantum", collect: "List[_Quantum]", send
+    ) -> bool:  # single-thread: scheduler
+        """Run (or resume) one quantum's pull loop.
+
+        ``send`` is ``_FRESH`` to initiate new pulls, or the fused partial
+        (possibly None — the solo-fallback signal) to resume a parked
+        ``FoldRequest`` first.  The per-pull gates (RUNNING state, cancel,
+        queue fullness, source readiness) apply only when INITIATING a
+        fresh pull: a parked fold always resumes, because its window's
+        device work happened in the cohort dispatch and dropping the
+        resume would strand the emission.  The queue-full guarantee still
+        holds — fullness was checked before the pull that parked, and
+        this thread is the queue's sole producer.
+
+        Clocking (profiled: two ``perf_counter`` reads per pull were the
+        scheduler's second-hottest line behind the fold itself): ONE read
+        per record, rolled through ``q.t_prev``, spans gate overhead into
+        ``job_dispatch_s`` — nanoseconds against a device fold — and the
+        round-level health/SLO clock stays in ``_loop``, once per round,
+        never per pull.
+        """
+        job = q.job
+        ready = job._ready
         # tag this thread with the job id for the duration of its pulls:
         # histograms recorded deep inside the merge loops / network source
         # (close-to-emission, push-to-fold) land in this job's rows too
         prev_scope = metrics.set_hist_job(job.job_id)
         try:
-            for _ in range(credits):
-                if not job._state_in(JobState.RUNNING):
-                    break
-                if job._cancel_pending():
-                    break
-                if job._out.full():
-                    metrics.job_add(job.job_id, "job_queue_full_skips", 1)
-                    break
-                if pulled and ready is not None and not ready():
-                    # re-check between pulls: each pull drains a window's
-                    # worth from the source, so readiness established for
-                    # the FIRST pull says nothing about the rest of the
-                    # quantum — a pull past the queued data would block the
-                    # scheduler thread on that job's producer (the wedge
-                    # the gate exists to prevent)
-                    break
-                if job._it is None:
-                    build = job._build
-                    if build is None:
-                        break  # raced a concurrent terminal transition
-                    # lazy build: first schedule pays the query's setup
-                    # (including any cold compile) on the scheduler thread —
-                    # cooperative by design, amortized by the shared cache
-                    job._it = iter(build())
-                t0 = time.perf_counter()
-                try:
-                    rec = next(job._it)
-                except StopIteration:
-                    with self._lock:
-                        job._transition(JobState.DRAINING)
-                    self._enqueue_sentinel(job)
-                    pulled += 1
-                    break
-                except BaseException as e:
-                    self._fail(job, e)
-                    pulled += 1
-                    break
+            while True:
+                if send is _FRESH:
+                    if q.pulled >= q.credits:
+                        break
+                    if not job._state_in(JobState.RUNNING):
+                        break
+                    if job._cancel_pending():
+                        break
+                    if job._out.full():
+                        metrics.job_add(job.job_id, "job_queue_full_skips", 1)
+                        break
+                    if q.pulled and ready is not None and not ready():
+                        # re-check between pulls: each pull drains a
+                        # window's worth from the source, so readiness
+                        # established for the FIRST pull says nothing
+                        # about the rest of the quantum — a pull past the
+                        # queued data would block the scheduler thread on
+                        # that job's producer (the wedge the gate exists
+                        # to prevent)
+                        break
+                    if job._it is None:
+                        build = job._build
+                        if build is None:
+                            break  # raced a concurrent terminal transition
+                        # lazy build: first schedule pays the query's setup
+                        # (including any cold compile) on the scheduler
+                        # thread — cooperative by design, amortized by the
+                        # shared cache
+                        job._it = iter(build())
+                        q.t_prev = time.perf_counter()
+                    try:
+                        rec = next(job._it)
+                    except StopIteration:
+                        with self._lock:
+                            job._transition(JobState.DRAINING)
+                        self._enqueue_sentinel(job)
+                        q.pulled += 1
+                        break
+                    except BaseException as e:
+                        self._fail(job, e)
+                        q.pulled += 1
+                        break
+                else:
+                    partial, send = send, _FRESH
+                    q.t_prev = time.perf_counter()
+                    try:
+                        rec = job._it.send(partial)
+                    except StopIteration:
+                        with self._lock:
+                            job._transition(JobState.DRAINING)
+                        self._enqueue_sentinel(job)
+                        q.pulled += 1
+                        break
+                    except BaseException as e:
+                        self._fail(job, e)
+                        q.pulled += 1
+                        break
+                if type(rec) is FoldRequest:
+                    # park: the window's fold is offered to this round's
+                    # cohort; the quantum resumes from _dispatch_cohorts
+                    q.request = rec
+                    collect.append(q)
+                    return bool(q.pulled)
                 t_rec = time.perf_counter()
-                metrics.job_add(job.job_id, "job_dispatch_s", t_rec - t0)
+                metrics.job_add(job.job_id, "job_dispatch_s", t_rec - q.t_prev)
+                q.t_prev = t_rec
                 metrics.job_add(job.job_id, "job_dispatches", 1)
                 metrics.job_add(job.job_id, "job_records", 1)
                 if not job._first_emitted:
@@ -814,10 +946,10 @@ class JobManager:
                 metrics.job_high_water(
                     job.job_id, "job_queue_depth_hwm", job._out.qsize()
                 )
-                pulled += 1
+                q.pulled += 1
         finally:
             metrics.set_hist_job(prev_scope)
-        if pulled:
+        if q.pulled:
             # scheduler queue wait: the gap from this job's previous
             # PRODUCTIVE quantum to this one's start — what a closed
             # window waits before the shared scheduler gets back to its
@@ -828,12 +960,104 @@ class JobManager:
             if job._last_quantum_end is not None:
                 metrics.hist_record(
                     "sched_queue_wait_ms",
-                    (t_round - job._last_quantum_end) * 1e3,
+                    (q.t_round - job._last_quantum_end) * 1e3,
                     job=job.job_id,
                 )
             metrics.job_add(job.job_id, "job_sched_rounds", 1)
             job._last_quantum_end = time.perf_counter()
-        return bool(pulled)
+        return bool(q.pulled)
+
+    def _dispatch_cohorts(
+        self, pending: "List[_Quantum]"
+    ) -> bool:  # single-thread: scheduler
+        """Drain the round's parked quanta through cohort dispatch cycles.
+
+        Each cycle groups parked ``FoldRequest``s by key — (descriptor
+        cache token, frozen config, has-val, pow2 pane bucket) — so only
+        windows that would compile and trace IDENTICALLY may share a
+        dispatch; each cohort folds once and every member resumes with
+        its own row.  Resumed quanta may park again at their next window,
+        feeding the next cycle; total pulls per round stay bounded by the
+        per-job credit budgets, so the cycles terminate.
+        """
+        progressed = False
+        while pending:
+            quanta, pending = pending, []
+            cohorts: Dict[tuple, List[_Quantum]] = {}
+            for q in quanta:
+                cohorts.setdefault(q.request.key, []).append(q)
+            for qs in cohorts.values():
+                try:
+                    partials = self._fused_fold(qs)
+                except BaseException as e:
+                    # a cohort-level dispatch failure fails its MEMBERS
+                    # (their windows were in that dispatch), not the round
+                    for q in qs:
+                        self._fail(q.job, e)
+                    continue
+                for q, partial in zip(qs, partials):
+                    q.request = None
+                    if q.job._it is None:
+                        continue  # raced a terminal transition mid-round
+                    try:
+                        progressed |= self._pull_loop(q, pending, partial)
+                    except BaseException as e:
+                        self._fail(q.job, e)
+        return progressed
+
+    def _fused_fold(self, qs: "List[_Quantum]"):  # single-thread: scheduler
+        """One cohort's device work: N parked same-key windows stacked into
+        the superbatch row layout and folded by ONE call to the shared
+        vmapped executable.  Returns one per-row partial per member, in
+        member order; a singleton cohort returns ``[None]`` — the member
+        solo-folds in its own generator, keeping the oracle path exercised
+        even under fused mode.
+
+        The row axis is pow2-bucketed by ``stack_fold_rows``, so tenancy
+        varying 1..16 jobs revisits at most log2 bucket shapes and the
+        process-wide recompile guard stays at zero.  No host sync happens
+        here: the fold and the compiled per-row drain both dispatch
+        asynchronously and each member's partial stays a device pytree,
+        materialized only where the plain plane would have synced anyway
+        (transform at emission).
+        """
+        if len(qs) == 1:
+            metrics.fused_add("fused_solo_fallbacks", 1)
+            return [None]
+        import jax
+        import jax.numpy as jnp
+
+        reqs = [q.request for q in qs]
+        src, dst, val, msk, pad_rows = stack_fold_rows(reqs)
+        t0 = time.perf_counter()
+        states = reqs[0].fold(
+            jnp.asarray(src),
+            jnp.asarray(dst),
+            None if val is None else jax.tree.map(jnp.asarray, val),
+            jnp.asarray(msk),
+        )
+        # drain in ONE dispatch too: the compiled split slices the stacked
+        # result into per-row partials (eager per-row a[i] slices cost one
+        # device call per job — measured ~2x the fused fold itself at 16
+        # rows — and would undo the amortization the mega-fold just bought)
+        rows = len(qs) + pad_rows
+        parts = reqs[0].split(rows)(states)
+        # the one dispatch's wall time attributes evenly: each tenant row
+        # cost ~1/N of the fused call, and the per-job histograms/benches
+        # read job_dispatch_s exactly as they do for solo dispatch
+        share = (time.perf_counter() - t0) / len(qs)
+        metrics.fused_add("fused_dispatches", 1)
+        metrics.fused_add("fused_jobs_total", len(qs))
+        metrics.fused_add("fused_pad_rows_total", pad_rows)
+        metrics.fused_high_water("fused_jobs_per_dispatch_hwm", len(qs))
+        with self._lock:
+            for q in qs:
+                q.job._fused_windows += 1
+        partials = []
+        for i, q in enumerate(qs):
+            metrics.job_add(q.job.job_id, "job_dispatch_s", share)
+            partials.append(parts[i])
+        return partials
 
     def _sample_health(self, jobs, now: float) -> None:  # single-thread: scheduler
         """One keep-up gauge sweep over the live jobs (ISSUE 10).

@@ -1482,11 +1482,29 @@ class StreamServer:
         cannot drift between the two paths.  ``cfg``/``source`` are
         explicit because a rescale submits the NEW geometry before
         swapping them into ``sj``."""
-        build = lambda: iter(  # noqa: E731 — OutputStream contract
-            source.stream().aggregate(
-                sj.descriptor, checkpoint_path=sj.checkpoint_path
+        from gelly_streaming_tpu.core import aggregation
+
+        eligible = getattr(sj.descriptor, "fused_eligible", None)
+
+        def build():  # the OutputStream contract: a fresh records iterator
+            stream = source.stream()
+            # served tenants are the fused plane's home case: N push jobs
+            # with shared library descriptors (class-level cache tokens)
+            # on the windowed plane stack into cross-tenant mega-folds;
+            # anything else keeps descriptor.run — the oracle path
+            if (
+                aggregation.resolve_fused_dispatch(cfg)
+                and eligible is not None
+                and eligible(stream)
+            ):
+                return sj.descriptor.run_fused(
+                    stream, checkpoint_path=sj.checkpoint_path
+                )
+            return iter(
+                stream.aggregate(
+                    sj.descriptor, checkpoint_path=sj.checkpoint_path
+                )
             )
-        )
         return self.manager.submit(
             build,
             name=key,

@@ -932,6 +932,72 @@ def reset_histograms() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Cross-tenant fused dispatch accounting (runtime/manager.py cohorts over
+# core/aggregation.py's FoldRequest plane).  Process-global like the pipeline
+# counters: cohorts form on the one scheduler thread but stats drain from
+# bench/server/metrics threads, so the lock is load-bearing, not ceremony.
+
+
+_FUSED_LOCK = threading.Lock()
+
+
+def _fused_zero() -> dict:
+    return {
+        # vmapped mega-folds dispatched (>= 2 tenant rows each)
+        "fused_dispatches": 0,
+        # tenant-job rows folded across all fused dispatches (mean
+        # jobs-per-dispatch = fused_jobs_total / fused_dispatches)
+        "fused_jobs_total": 0,
+        # most tenant rows ever folded by one dispatch
+        "fused_jobs_per_dispatch_hwm": 0,
+        # windows that found no same-key peer and solo-dispatched (the
+        # oracle path) despite fused mode being on
+        "fused_solo_fallbacks": 0,
+        # pow2 bucket padding: all-masked rows dispatched (bucket size
+        # minus live cohort rows, summed) — the cost of 0-recompile
+        # tenancy variation
+        "fused_pad_rows_total": 0,
+    }
+
+
+_FUSED = _fused_zero()  # guarded-by: _FUSED_LOCK
+
+
+def fused_add(key: str, amount: int) -> None:
+    """Accumulate a fused-dispatch counter (thread-safe; hot-path cheap)."""
+    with _FUSED_LOCK:
+        _FUSED[key] += amount
+
+
+def fused_high_water(key: str, value: int) -> None:
+    """Raise a fused-dispatch high-water mark to ``value`` if higher."""
+    with _FUSED_LOCK:
+        if value > _FUSED[key]:
+            _FUSED[key] = value
+
+
+def fused_dispatch_stats() -> dict:
+    """Process-wide cross-tenant fused-dispatch counters: mega-fold count,
+    jobs-per-dispatch HWM and mean, solo fallbacks, and pow2 bucket pad
+    waste.  Reported by bench.py next to ``compile_cache_stats``."""
+    with _FUSED_LOCK:
+        out = dict(_FUSED)
+    n = out["fused_dispatches"]
+    out["fused_jobs_per_dispatch_mean"] = (
+        round(out["fused_jobs_total"] / n, 4) if n else 0.0
+    )
+    return out
+
+
+def reset_fused_dispatch_stats() -> None:
+    """Zero the fused-dispatch counters (call before a measurement window,
+    read ``fused_dispatch_stats`` after)."""
+    global _FUSED
+    with _FUSED_LOCK:
+        _FUSED = _fused_zero()
+
+
+# ---------------------------------------------------------------------------
 # exposition: one snapshot of every registry, plus a Prometheus renderer
 
 
@@ -950,6 +1016,7 @@ def metrics_snapshot() -> dict:
         "comms": comms_stats(),
         "wire": wire_stats(),
         "compile_cache": compile_cache_stats(),
+        "fused": fused_dispatch_stats(),
         "jobs": all_job_stats(),
         "job_totals": job_totals(),
         "tenants": all_tenant_stats(),
@@ -1007,7 +1074,14 @@ def render_prometheus(snap: Optional[dict] = None) -> str:
         )
         fam["samples"].append((label, value))
 
-    for section in ("pipeline", "comms", "wire", "compile_cache", "events"):
+    for section in (
+        "pipeline",
+        "comms",
+        "wire",
+        "compile_cache",
+        "fused",
+        "events",
+    ):
         for key, val in sorted(snap.get(section, {}).items()):
             add(key, val)
     # labeled rows grouped PER KEY (one family's series stay contiguous)

@@ -1,0 +1,42 @@
+"""Seeded bugs: the fused-dispatch cohort registry mutated outside its
+lock, and a blocking host sync inside the '# hot-loop' collect pass.
+
+Expected findings: one HOTSYNC + three UNGUARDED (the high-water
+check-then-act flags both the unlocked read and the unlocked store).
+Analyzer input only — never imported.
+"""
+
+import threading
+
+import numpy as np
+
+
+class CohortBoard:
+    """Parked FoldRequests grouped by cohort key — written by the
+    scheduler's collect pass while status/metrics threads snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._parked = 0  # guarded-by: _lock
+        self._hwm = 0  # guarded-by: _lock
+
+    def park(self, request):
+        self._parked += 1  # BUG: scheduler bump races the snapshot reader
+
+    def high_water(self, n):
+        if n > self._hwm:
+            self._hwm = n  # BUG: check-then-act store outside the lock
+
+    def snapshot(self):
+        with self._lock:
+            return self._parked, self._hwm
+
+
+def collect(board, quanta):
+    rows = []
+    # hot-loop: cohort collect pass (stack rows; dispatch stays async)
+    for q in quanta:
+        rows.append(np.asarray(q.src))  # BUG: one sync restores lockstep
+        board.park(q)
+    # hot-loop-end
+    return rows

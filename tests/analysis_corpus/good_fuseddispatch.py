@@ -1,0 +1,41 @@
+"""Clean counterpart: every cohort-registry access holds its lock, and
+the collect pass defers materialization past the hot loop (lazy row
+slices; the sink materializes where the solo plane would have synced).
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+
+class CohortBoard:
+    """Parked FoldRequests grouped by cohort key — written by the
+    scheduler's collect pass while status/metrics threads snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._parked = 0  # guarded-by: _lock
+        self._hwm = 0  # guarded-by: _lock
+
+    def park(self, request):
+        with self._lock:
+            self._parked += 1
+
+    def high_water(self, n):
+        with self._lock:
+            if n > self._hwm:
+                self._hwm = n
+
+    def snapshot(self):
+        with self._lock:
+            return self._parked, self._hwm
+
+
+def collect(board, quanta):
+    rows = []
+    # hot-loop: cohort collect pass (stack rows; dispatch stays async)
+    for q in quanta:
+        rows.append(q.src)  # already a padded host row; no device sync
+        board.park(q)
+    # hot-loop-end
+    return rows

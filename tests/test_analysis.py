@@ -336,6 +336,27 @@ def test_corpus_decodepool():
     assert _analyze("good_decodepool.py") == []
 
 
+def test_corpus_fuseddispatch():
+    """The cross-tenant fused-dispatch fixtures (ISSUE 16): the cohort
+    registry the scheduler bumps while status/metrics threads snapshot is
+    '# guarded-by:' its lock (the high-water check-then-act flags both
+    its unlocked read and store), and the cohort COLLECT pass is a
+    '# hot-loop' region — rows stack and the mega-fold dispatches async,
+    so one host sync there re-serializes the N tenants the fusion exists
+    to batch."""
+    findings = _analyze("bad_fuseddispatch.py")
+    assert _codes(findings) == [
+        "HOTSYNC",
+        "UNGUARDED",
+        "UNGUARDED",
+        "UNGUARDED",
+    ]
+    assert any("self._parked" in f.message for f in findings)
+    assert any("self._hwm" in f.message for f in findings)
+    assert any("np.asarray" in f.message for f in findings)
+    assert _analyze("good_fuseddispatch.py") == []
+
+
 def test_corpus_native():
     """The C++ decode-plane fixtures (ISSUE 15): all four nativecheck rule
     families fire on their seeded defects — ctypes signature drift (arity,

@@ -88,6 +88,19 @@ def test_direction_rules():
     assert bench._bench_direction("serving_push_to_fold_p99_ms") == "lower"
     assert bench._bench_direction("serving_decode_workers") is None
     assert bench._bench_direction("serving_decode_native") is None
+    # the fused-dispatch headlines (ISSUE 16): aggregate eps at 16 jobs,
+    # the fused-vs-solo speedup, scheduler fairness, and bit-exact parity
+    # all regress downward; the retrace guard upward (recompiles rule);
+    # cohort-shape figures are informational only
+    assert bench._bench_direction("fused_agg_eps_16") == "higher"
+    assert bench._bench_direction("fused_vs_solo_speedup") == "higher"
+    assert bench._bench_direction("fairness_min_max_fused") == "higher"
+    assert bench._bench_direction("fused_parity_ok") == "higher"
+    assert bench._bench_direction("fused_recompiles_after_warm") == "lower"
+    assert bench._bench_direction("fused_compiles_after_warm") is None
+    assert bench._bench_direction("fused_jobs_per_dispatch_hwm") is None
+    assert bench._bench_direction("fused_jobs_per_dispatch_mean") is None
+    assert bench._bench_direction("fused_solo_fallbacks") is None
 
 
 def test_fresh_at_best_passes(baselines, capsys):
