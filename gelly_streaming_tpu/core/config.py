@@ -137,6 +137,22 @@ class StreamConfig:
     # var may switch it on process-wide (the async_windows pattern).
     # Sampling is a deterministic stride (every round(1/rate)-th window).
     trace_sample: float = 0.0
+    # Push/pull direction optimization for the masked-SpMV kernel core
+    # (ops/spmv.py): iterative vertex programs (sssp, pagerank, ...) pick
+    # per-regime between a sparse push lowering (SpMSpV: expand the active
+    # frontier's CSR rows into a pow2-bucketed candidate buffer and
+    # scatter-reduce) and a dense pull lowering (SpMV: one gather over
+    # dst-sorted edges + a sorted segment reduce).  spmv_direction:
+    # "push"/"pull" force one lowering for every iteration; "auto" switches
+    # on frontier density; "" (default) defers to the GELLY_SPMV_DIRECTION
+    # env var (default auto).  Results are bit-identical in every mode
+    # (pinned by tests/test_spmv.py) — this is a performance knob only.
+    spmv_direction: str = ""
+    # Frontier-density threshold for "auto": iterate push while
+    # |frontier| / |active vertices| <= threshold, pull above it.
+    # -1.0 (default) defers to GELLY_DIRECTION_THRESHOLD, then the kernel
+    # default (ops/spmv.DEFAULT_DIRECTION_THRESHOLD).
+    direction_threshold: float = -1.0
     # Bounded event-time out-of-orderness (ms): 0 keeps the reference's
     # ascending-timestamp contract (SimpleEdgeStream.java:86-90); positive
     # values trail the watermark behind max seen time by the bound, holding
@@ -184,6 +200,17 @@ class StreamConfig:
             raise ValueError("wire_compress must be -1 (auto), 0, or 1")
         if self.fused_dispatch not in (-1, 0, 1):
             raise ValueError("fused_dispatch must be -1 (auto), 0, or 1")
+        if self.spmv_direction not in ("", "auto", "push", "pull"):
+            raise ValueError(
+                "spmv_direction must be ''/auto/push/pull "
+                "('' defers to GELLY_SPMV_DIRECTION)"
+            )
+        if self.direction_threshold != -1.0 and not (
+            0.0 <= self.direction_threshold <= 1.0
+        ):
+            raise ValueError(
+                "direction_threshold must be -1 (defer) or a density in [0, 1]"
+            )
         if self.wire_compress == 1 and self.binned_ingest == 0:
             raise ValueError(
                 "wire_compress=1 needs binned batches (delta encoding rides "

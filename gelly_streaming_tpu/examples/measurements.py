@@ -600,10 +600,8 @@ def measure_pagerank(args) -> dict:
 
     from gelly_streaming_tpu.core.config import StreamConfig
     from gelly_streaming_tpu.core.stream import EdgeStream
-    from gelly_streaming_tpu.library.pagerank import (
-        _pane_pagerank,
-        pagerank_windows,
-    )
+    from gelly_streaming_tpu.library.pagerank import pagerank_windows
+    from gelly_streaming_tpu.ops import spmv
 
     rng = np.random.default_rng(args.seed)
     window_ms = 1000
@@ -633,14 +631,17 @@ def measure_pagerank(args) -> dict:
     s_a = jnp.asarray(np.resize(src[:per_w], e_pad).astype(np.int32))
     d_a = jnp.asarray(np.resize(dst[:per_w], e_pad).astype(np.int32))
     m_a = jnp.asarray(np.arange(e_pad) < per_w)
-    c_args = (
-        s_a, d_a, m_a, args.vertices,
-        jnp.float32(0.85), jnp.float32(args.tol), jnp.int32(100),
-    )
-    r, _, iters = _pane_pagerank(*c_args)
+    op = spmv.prepare_pane(s_a, d_a, None, m_a, args.vertices)
+
+    def one_pane():
+        return spmv.pagerank_fixpoint(
+            op, damping=0.85, tol=args.tol, max_iters=100
+        )
+
+    r, _, iters = one_pane()
     jax.block_until_ready(r)
     t1 = time.perf_counter()
-    r, _, iters = _pane_pagerank(*c_args)
+    r, _, iters = one_pane()
     jax.block_until_ready(r)
     dev_ms = (time.perf_counter() - t1) * 1e3
     return {

@@ -16,13 +16,11 @@ affected vertices as merges happen.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gelly_streaming_tpu.core import compile_cache
-from gelly_streaming_tpu.core.config import StreamConfig
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
+from gelly_streaming_tpu.ops import spmv
 from gelly_streaming_tpu.ops import unionfind as uf
 
 
@@ -30,19 +28,11 @@ class IterativeConnectedComponents:
     """Continuous (vertex, component) stream with on-device label propagation."""
 
     def __init__(self):
-        def build():
-            def kernel(parent, seen, src, dst, mask):
-                parent, seen = uf.union_edges_with_seen(
-                    parent, seen, src, dst, mask
-                )
-                return parent, seen
-
-            return kernel
-
-        # graftcheck RAWJIT fix: the kernel closes over nothing per-instance,
-        # so every IterativeConnectedComponents can share one executable via
-        # the process-global cache instead of re-jitting per construction
-        self._kernel = compile_cache.cached_jit(("iterative_cc_union",), build)
+        # the min-min semiring fixpoint on the kernel core: hooking is a
+        # masked scatter-min of labels, compression is pointer doubling —
+        # one shared process-global executable (ops/spmv.cc_fixpoint),
+        # array-identical to unionfind.union_edges_with_seen
+        self._kernel = spmv.cc_fixpoint
 
     def run(self, stream) -> OutputStream:
         cfg = stream.cfg

@@ -19,16 +19,16 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gelly_streaming_tpu.core import compile_cache
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.core.windows import windowed_panes
 from gelly_streaming_tpu.ops import neighborhoods as nbh_ops
+from gelly_streaming_tpu.ops import spmv
 
 
-@jax.jit
 def _h_index_rows(vals, valid):
     """Row-wise H-index of the valid entries of [K, D] ``vals``: the largest
     h such that at least h entries are >= h (invalid entries count 0)."""
@@ -38,16 +38,27 @@ def _h_index_rows(vals, valid):
     return jnp.max(jnp.where(s >= ranks, ranks, 0), axis=1).astype(jnp.int32)
 
 
-@jax.jit
-def _bucket_round(c, keys, nbrs, valid, num_keys):
-    """One h-index update for one bucket: gather neighbor estimates, take
-    row H-indices, scatter back at the bucket's keys.  Rows beyond
-    ``num_keys`` are padding whose key ids alias real vertices — they
-    scatter INT32_MAX so the min never touches anyone's estimate."""
-    h = _h_index_rows(c[nbrs], valid)
-    real = jnp.arange(keys.shape[0]) < num_keys
-    return c.at[keys].min(jnp.where(real, h, jnp.int32(2**31 - 1)))
+def _build_bucket_round():
+    def kernel(c, keys, nbrs, valid, num_keys):
+        # One h-index update for one bucket: gather neighbor estimates,
+        # take row H-indices, scatter-min back at the bucket's keys (the
+        # kernel core's min-combine scatter).  Rows beyond ``num_keys`` are
+        # padding whose key ids alias real vertices — they scatter the
+        # min-min identity (INT32_MAX) so the min never touches anyone's
+        # estimate.
+        h = _h_index_rows(c[nbrs], valid)
+        real = jnp.arange(keys.shape[0]) < num_keys
+        ident = jnp.asarray(spmv.MIN_MIN.identity, h.dtype)
+        return spmv.MIN_MIN.scatter(c, keys, jnp.where(real, h, ident))
 
+    return kernel
+
+
+# shared process-global executable (one per bucket shape) instead of a raw
+# module-level jax.jit outside the compile-cache retrace guard
+_bucket_round = compile_cache.cached_jit(
+    ("kcore_bucket_round",), _build_bucket_round, label="spmv"
+)
 
 _build_buckets_j = nbh_ops.build_buckets_jit
 
@@ -92,9 +103,11 @@ def core_numbers_windows(
         buckets = [bkt for bkt in buckets if int(bkt.num_keys) > 0]
 
         # estimates start at degree (the h-index sequence is non-increasing
-        # from any upper bound); off-window vertices stay 0
-        c = jnp.zeros((capacity,), jnp.int32)
-        c = c.at[jnp.asarray(src)].add(jnp.asarray(msk, jnp.int32))
+        # from any upper bound); off-window vertices stay 0.  Counting
+        # incidence is the kernel core's plus-one scatter.
+        c = spmv.scatter_into(
+            spmv.PLUS_ONE, capacity, src, np.ones((e_pad,), np.int32), msk
+        )
         bound = max_rounds if max_rounds is not None else e2 + 1
         converged = False
         for _ in range(bound):

@@ -2,11 +2,13 @@
 
 Not present in the reference (its library stops at CC / bipartiteness /
 spanner / triangles / matching — `library/` in SURVEY.md §2.1); PageRank is
-the canonical "snapshot analytics over a windowed graph stream" workload and
-maps cleanly onto the TPU design: each closed pane's subgraph becomes dense
-[C]-indexed arrays and the power iteration is a fixed-shape
-``segment_sum``-style scatter-add under ``lax.while_loop`` — no per-vertex
-Python, no dynamic shapes, one compiled step reused across panes.
+the canonical "snapshot analytics over a windowed graph stream" workload.
+Each closed pane's subgraph becomes dense [C]-indexed arrays and the damped
+power iteration runs on the kernel core's plus-times semiring
+(ops/spmv.pagerank_fixpoint): the mass spread is a masked SpMV whose
+direction — arrival-order scatter-add (push) or dst-stable-sorted segment
+sum (pull) — is a traced ``lax.cond`` flag, bit-identical either way
+(tests/test_spmv.py pins it).
 
 Semantics per window (the standard damped random surfer restricted to the
 pane's subgraph): vertices = endpoints present in the window; uniform
@@ -22,57 +24,13 @@ ranks every sliding window via the shared pane-sharing dispatch
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Iterator, Optional, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.core.windows import pad_pane_edges, windowed_panes
-
-
-@partial(jax.jit, static_argnames=("capacity",))
-def _pane_pagerank(src, dst, mask, capacity, damping, tol, max_iters):
-    """Ranks [C] for one pane's (padded) edge list; zeros off-window.
-
-    src/dst: int32 [E_pad] (padding ignored via ``mask``).  The window's
-    vertex set, out-degrees, dangling set, and the scatter-add transition
-    are all dense [C] arrays — the same capacity-bounded layout every other
-    summary in the framework uses.
-    """
-    zeros = jnp.zeros((capacity,), jnp.float32)
-    ones = jnp.ones_like(zeros)
-    m = mask.astype(jnp.float32)
-    # window membership + out-degree (src side carries the out-edges)
-    in_window = (
-        zeros.at[src].max(m).at[dst].max(m) > 0
-    )
-    out_deg = zeros.at[src].add(m)
-    n = jnp.maximum(jnp.sum(in_window.astype(jnp.float32)), 1.0)
-    dangling = in_window & (out_deg == 0)
-    base = jnp.where(in_window, (1.0 - damping) / n, 0.0)
-    safe_deg = jnp.maximum(out_deg, 1.0)
-
-    def body(state):
-        r, _, it = state
-        contrib = jnp.where(mask, r[src] / safe_deg[src], 0.0)
-        spread = zeros.at[dst].add(contrib)
-        dangling_mass = jnp.sum(jnp.where(dangling, r, 0.0)) / n
-        r_new = base + damping * (
-            spread + jnp.where(in_window, dangling_mass, 0.0)
-        )
-        delta = jnp.sum(jnp.abs(r_new - r))
-        return r_new, delta, it + 1
-
-    def cond(state):
-        _, delta, it = state
-        return (delta > tol) & (it < max_iters)
-
-    r0 = jnp.where(in_window, ones / n, 0.0)
-    r, _, iters = jax.lax.while_loop(cond, body, (r0, jnp.inf, 0))
-    return r, in_window, iters
+from gelly_streaming_tpu.ops import spmv
 
 
 def windowed_pagerank(
@@ -110,18 +68,18 @@ def pagerank_windows(
     """(vertex ids [V], ranks [V]) arrays per window — the array-level view
     of ``windowed_pagerank`` for callers composing further device work."""
     cfg = stream.cfg
+    # every iteration spreads all mass (no frontier), so direction is a
+    # whole-run choice; auto keeps the arrival-order push scatter (the
+    # historical bit-exact path — pull measures within noise here)
+    use_pull = spmv.resolve_direction(cfg) == "pull"
     for pane in windowed_panes(stream, window_ms, slide_ms):
         if pane.num_edges == 0:
             continue
         src, dst, msk = pad_pane_edges(pane)
-        r, in_w, _ = _pane_pagerank(
-            jnp.asarray(src),
-            jnp.asarray(dst),
-            jnp.asarray(msk),
-            cfg.vertex_capacity,
-            jnp.float32(damping),
-            jnp.float32(tol),
-            jnp.int32(max_iters),
+        op = spmv.prepare_pane(src, dst, None, msk, cfg.vertex_capacity)
+        r, in_w, _ = spmv.pagerank_fixpoint(
+            op, damping=damping, tol=tol, max_iters=max_iters,
+            use_pull=use_pull,
         )
         r_h, in_h = np.asarray(r), np.asarray(in_w)
         vids = np.nonzero(in_h)[0]

@@ -2,12 +2,11 @@
 
 Not present in the reference library (SURVEY.md §2.1); with windowed
 PageRank this completes the classic snapshot-analytics pair.  Per closed
-window the pane's subgraph relaxes as a dense scatter-min Bellman–Ford:
-
-    dist = min(dist, scatter_min(dst, dist[src] + w))
-
-under ``lax.while_loop`` until a fixed point (or the V-1 iteration bound) —
-fixed shapes, no per-vertex Python, one compiled step reused across panes.
+window the pane relaxes on the kernel core's min-plus semiring
+(ops/spmv.py): ``dist = min(dist, A^T dist)`` under direction-optimized
+push/pull fixpoint iteration — sparse frontiers expand through bucketed
+SpMSpV, dense phases take the flat segment-reduce SpMV, and the emitted
+distances are bit-identical in every direction mode (tests/test_spmv.py).
 Edge values are the weights (valueless streams relax hop counts); negative
 weights are rejected (min-plus relaxation's usual contract on streams).
 ``slide_ms`` composes through the shared pane dispatch
@@ -16,7 +15,6 @@ weights are rejected (min-plus relaxation's usual contract on streams).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -25,34 +23,9 @@ import numpy as np
 
 from gelly_streaming_tpu.core.output import OutputStream, RecordBlock
 from gelly_streaming_tpu.core.windows import pad_pane_edges, windowed_panes
+from gelly_streaming_tpu.ops import spmv
 
-_BIG = jnp.float32(1e30)  # unreached sentinel; big + max weight stays finite
-
-
-@partial(jax.jit, static_argnames=("capacity",))
-def _pane_sssp(src, dst, w, mask, source, capacity, max_iters):
-    """Distances [C] from ``source`` over one pane's (padded) edge list;
-    unreached vertices hold the ``_BIG`` sentinel (filtered by callers).
-    The whole relaxation runs in sentinel space — no per-iteration inf
-    translation; masked/padding edges contribute ``_BIG`` candidates that
-    can never win a min against a real distance."""
-    dist0 = jnp.full((capacity,), _BIG).at[source].set(0.0)
-
-    def body(state):
-        dist, _, it = state
-        cand = jnp.where(mask, dist[src] + w, _BIG)
-        relaxed = jnp.full((capacity,), _BIG).at[dst].min(cand)
-        new = jnp.minimum(dist, relaxed)
-        return new, jnp.any(new < dist), it + 1
-
-    def cond(state):
-        _, changed, it = state
-        return changed & (it < max_iters)
-
-    dist, _, iters = jax.lax.while_loop(
-        cond, body, (dist0, jnp.bool_(True), 0)
-    )
-    return dist, iters
+_BIG = np.float32(spmv.MIN_PLUS.identity)  # unreached sentinel
 
 
 def sssp_windows(
@@ -76,6 +49,8 @@ def sssp_windows(
         raise ValueError(
             f"source {source} outside [0, {cfg.vertex_capacity})"
         )
+    direction = spmv.resolve_direction(cfg)
+    threshold = spmv.resolve_threshold(cfg)
     for pane in windowed_panes(stream, window_ms, slide_ms):
         e = pane.num_edges
         if e == 0:
@@ -97,18 +72,21 @@ def sssp_windows(
             w = np.zeros((e_pad,), np.float32)
             w[:e] = wts
         else:
-            w = np.ones((e_pad,), np.float32)  # hop counts
+            w = None  # hop counts (unit weights)
         iters = max_iters if max_iters is not None else cfg.vertex_capacity - 1
-        dist, _ = _pane_sssp(
-            jnp.asarray(src),
-            jnp.asarray(dst),
-            jnp.asarray(w),
-            jnp.asarray(msk),
-            jnp.int32(source),
-            cfg.vertex_capacity,
-            jnp.int32(iters),
+        op = spmv.prepare_pane(src, dst, w, msk, cfg.vertex_capacity)
+        dist0 = jnp.full(
+            (cfg.vertex_capacity,), _BIG, jnp.float32
+        ).at[source].set(0.0)
+        res = spmv.fixpoint(
+            spmv.MIN_PLUS,
+            op,
+            dist0,
+            max_iters=iters,
+            direction=direction,
+            threshold=threshold,
         )
-        d = np.asarray(dist)
+        d = np.asarray(res.x)
         vids = np.nonzero(d < 1e30)[0]
         yield vids, d[vids]
 

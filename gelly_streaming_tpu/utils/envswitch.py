@@ -36,3 +36,31 @@ def resolve_switch(n: int, env_name: str, default: bool = False) -> bool:
     if n in (0, 1):
         return bool(n)
     return env_switch(env_name, default)
+
+
+def env_choice(name: str, choices: tuple, default: str) -> str:
+    """Parse enum env var ``name``: unset -> ``default``; a (case/space
+    insensitive) member of ``choices`` -> that member; anything else raises."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    val = env.strip().lower()
+    if val in choices:
+        return val
+    raise ValueError(
+        f"{name}={env!r} is not a recognized choice (use one of "
+        f"{'/'.join(choices)})"
+    )
+
+
+def resolve_choice(s: str, env_name: str, choices: tuple, default: str) -> str:
+    """Config > env > default: a non-empty ``s`` forces (must already be
+    validated to ``choices``); "" defers to ``env_choice``."""
+    if s:
+        if s not in choices:
+            raise ValueError(
+                f"{s!r} is not a recognized choice (use one of "
+                f"{'/'.join(choices)})"
+            )
+        return s
+    return env_choice(env_name, choices, default)

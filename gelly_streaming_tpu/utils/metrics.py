@@ -998,6 +998,68 @@ def reset_fused_dispatch_stats() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Masked-SpMV kernel core accounting (ops/spmv.py direction optimization).
+# Fixpoints run on whatever thread drives the window loop while stats drain
+# from bench/server/metrics threads, so the lock is load-bearing here too.
+
+
+_SPMV_LOCK = threading.Lock()
+
+# frontier-density histogram bins: bin b counts iterations whose density
+# landed in [b/8, (b+1)/8) — 8 SCALAR keys, not a nested dict, so the
+# Prometheus renderer (which skips non-scalar values) exports them
+SPMV_DENSITY_BINS = 8
+
+
+def _spmv_zero() -> dict:
+    d = {
+        # direction-optimized fixpoints driven to completion
+        "spmv_fixpoints": 0,
+        # iterations lowered as sparse push (SpMSpV) / dense pull (SpMV)
+        "spmv_push_iters": 0,
+        "spmv_pull_iters": 0,
+        # push<->pull flips WITHIN a fixpoint (the regime switches the
+        # density threshold actually bought)
+        "spmv_direction_switches": 0,
+    }
+    for b in range(SPMV_DENSITY_BINS):
+        d[f"spmv_density_hist_{b}"] = 0
+    return d
+
+
+_SPMV = _spmv_zero()  # guarded-by: _SPMV_LOCK
+
+
+def spmv_add(key: str, amount: int = 1) -> None:
+    """Accumulate a kernel-core counter (thread-safe; hot-path cheap)."""
+    with _SPMV_LOCK:
+        _SPMV[key] += amount
+
+
+def spmv_stats() -> dict:
+    """Process-wide masked-SpMV direction-optimization counters: push vs
+    pull iterations, direction switches per fixpoint, and the frontier-
+    density histogram.  Reported by bench.py beside
+    ``fused_dispatch_stats``."""
+    with _SPMV_LOCK:
+        out = dict(_SPMV)
+    total = out["spmv_push_iters"] + out["spmv_pull_iters"]
+    out["spmv_iters_total"] = total
+    out["spmv_push_fraction"] = (
+        round(out["spmv_push_iters"] / total, 4) if total else 0.0
+    )
+    return out
+
+
+def reset_spmv_stats() -> None:
+    """Zero the kernel-core counters (call before a measurement window,
+    read ``spmv_stats`` after)."""
+    global _SPMV
+    with _SPMV_LOCK:
+        _SPMV = _spmv_zero()
+
+
+# ---------------------------------------------------------------------------
 # exposition: one snapshot of every registry, plus a Prometheus renderer
 
 
@@ -1017,6 +1079,7 @@ def metrics_snapshot() -> dict:
         "wire": wire_stats(),
         "compile_cache": compile_cache_stats(),
         "fused": fused_dispatch_stats(),
+        "spmv": spmv_stats(),
         "jobs": all_job_stats(),
         "job_totals": job_totals(),
         "tenants": all_tenant_stats(),
@@ -1080,6 +1143,7 @@ def render_prometheus(snap: Optional[dict] = None) -> str:
         "wire",
         "compile_cache",
         "fused",
+        "spmv",
         "events",
     ):
         for key, val in sorted(snap.get(section, {}).items()):

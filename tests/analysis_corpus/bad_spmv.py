@@ -1,0 +1,38 @@
+"""Seeded bug: the direction-optimized SpMV kernel done wrong — a Python
+branch on the traced frontier density picks the lowering (concretizes the
+tracer; at best a ConcretizationTypeError, at worst a per-density retrace),
+and the window dispatch loop syncs every result back to the host.
+
+Expected findings: exactly one TRACEIF and one HOTSYNC.
+Analyzer input only — never imported.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core import compile_cache
+
+CAPACITY = 1024
+
+
+def make():
+    def step(d_src, d_w, d_msk, x, fm, thr):
+        if jnp.sum(fm) / CAPACITY > thr:  # BUG: value branch on the density
+            cand = jnp.where(d_msk, x[d_src] + d_w, jnp.float32(1e30))
+            return jnp.minimum(x, cand[:CAPACITY])
+        return x
+
+    return step
+
+
+step = compile_cache.cached_jit(("corpus_spmv_step",), make)
+
+
+def drive(panes, x, fm, thr):
+    dists = []
+    # hot-loop: per-window direction-optimized dispatch
+    for pane in panes:
+        x = step(pane.d_src, pane.d_w, pane.d_msk, x, fm, thr)
+        dists.append(np.asarray(x))  # BUG: one sync per window = lockstep
+    # hot-loop-end
+    return dists

@@ -1,0 +1,41 @@
+"""Good twin of bad_spmv.py: the per-iteration direction pick is a
+branchless ``lax.cond`` on the traced density (one executable serves both
+lowerings; force modes fold into the threshold scalar), and the dispatch
+loop keeps results on device — the single drain sync sits after the
+region's end, allowlisted where the protocol requires it.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core import compile_cache
+
+CAPACITY = 1024
+
+
+def make():
+    def step(d_src, d_w, d_msk, x, fm, thr):
+        def pull(x):
+            cand = jnp.where(d_msk, x[d_src] + d_w, jnp.float32(1e30))
+            return jnp.minimum(x, cand[:CAPACITY])
+
+        dens = jnp.sum(fm).astype(jnp.float32) / CAPACITY
+        return jax.lax.cond(dens > thr, pull, lambda x: x, x)
+
+    return step
+
+
+step = compile_cache.cached_jit(("corpus_spmv_step_good",), make)
+
+
+def drive(panes, x, fm, thr):
+    dists = []
+    # hot-loop: per-window direction-optimized dispatch
+    for pane in panes:
+        x = step(pane.d_src, pane.d_w, pane.d_msk, x, fm, thr)
+        dists.append(x)  # stays on device; drained once below
+    # hot-loop-end
+    return [np.asarray(d) for d in dists]

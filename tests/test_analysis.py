@@ -384,6 +384,21 @@ def test_corpus_native():
     assert _analyze("good_native.cpp") == []
 
 
+def test_corpus_spmv():
+    """The direction-optimized SpMV fixtures (ISSUE 17): picking the
+    push/pull lowering with a Python ``if`` on the traced frontier density
+    is a TRACEIF (the density is a value, not a shape), and syncing every
+    window's result inside the dispatch hot-loop is a HOTSYNC; the twin
+    that branches via ``lax.cond`` and drains once after the region scans
+    clean."""
+    findings = _analyze("bad_spmv.py")
+    assert _codes(findings) == ["HOTSYNC", "TRACEIF"]
+    msgs = "\n".join(f.message for f in findings)
+    assert "thr" in msgs or "fm" in msgs
+    assert "np.asarray" in msgs
+    assert _analyze("good_spmv.py") == []
+
+
 def test_native_passes_only_see_cpp_and_vice_versa():
     """Language routing: the Python passes must not choke on (or scan) a
     .cpp file, and the native passes stay silent on .py sources — the same

@@ -101,6 +101,17 @@ def test_direction_rules():
     assert bench._bench_direction("fused_jobs_per_dispatch_hwm") is None
     assert bench._bench_direction("fused_jobs_per_dispatch_mean") is None
     assert bench._bench_direction("fused_solo_fallbacks") is None
+    # the spmv kernel-core headlines (ISSUE 17): the direction-optimization
+    # speedup, pagerank throughput, and cross-direction answer parity all
+    # regress downward; the retrace guard upward; the registry counters
+    # (iteration split, density histogram, switches) are informational
+    assert bench._bench_direction("spmv_direction_speedup") == "higher"
+    assert bench._bench_direction("spmv_pagerank_eps") == "higher"
+    assert bench._bench_direction("spmv_parity_ok") == "higher"
+    assert bench._bench_direction("spmv_recompiles_after_warm") == "lower"
+    assert bench._bench_direction("spmv_push_iters") is None
+    assert bench._bench_direction("spmv_density_hist_0") is None
+    assert bench._bench_direction("spmv_direction_switches") is None
 
 
 def test_fresh_at_best_passes(baselines, capsys):
