@@ -184,6 +184,9 @@ class SourceFile:
         self.comments: Dict[int, str] = {}
         #: lineno -> set of codes disabled on that line ('*' disables all)
         self.suppressions: Dict[int, Set[str]] = {}
+        #: comment lines whose disable actually silenced a finding this
+        #: run — the stale-suppression check (STALEDISABLE) reads this
+        self.used_suppressions: Set[int] = set()
         if self.language == "cpp":
             # no Python parse: C++ files carry no AST; the native passes
             # lex the text themselves, and parse_error stays None so the
@@ -225,9 +228,10 @@ class SourceFile:
             marker in self.comments.get(i, "") for i in range(start, end + 1)
         )
 
-    def suppressed(self, lineno: int, code: str) -> bool:
-        """Suppression applies on the finding's own line or as a standalone
-        comment on the line directly above it."""
+    def suppressing_line(self, lineno: int, code: str) -> Optional[int]:
+        """The comment line whose disable governs a finding at ``lineno``
+        (the finding's own line, else a standalone comment directly
+        above), or None when nothing suppresses it."""
         marker = "//" if self.language == "cpp" else "#"
         for at in (lineno, lineno - 1):
             codes = self.suppressions.get(at)
@@ -238,8 +242,13 @@ class SourceFile:
                 ):
                     continue  # the line above holds code: its trailing
                     # comment governs that line, not this one
-                return True
-        return False
+                return at
+        return None
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        """Suppression applies on the finding's own line or as a standalone
+        comment on the line directly above it."""
+        return self.suppressing_line(lineno, code) is not None
 
     def finding(self, lineno: int, pass_name: str, code: str, message: str) -> Finding:
         return Finding(self.display_path, lineno, pass_name, code, message)
@@ -257,6 +266,10 @@ class Pass:
     #: source languages the pass understands; the framework only hands it
     #: matching SourceFiles (the nativecheck passes set ("cpp",))
     languages: Tuple[str, ...] = ("python",)
+    #: a POST check runs after every ordinary pass has reported on the
+    #: whole scanned set (the stale-suppression pass needs the final
+    #: used-suppression map); its ``run`` is never called by the framework
+    post_check: bool = False
 
     def run(self, sf: SourceFile) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -302,6 +315,8 @@ def load_passes() -> Dict[str, Pass]:
     from gelly_streaming_tpu.analysis import concurrency  # noqa: F401
     from gelly_streaming_tpu.analysis import testdiscipline  # noqa: F401
     from gelly_streaming_tpu.analysis import nativecheck  # noqa: F401
+    from gelly_streaming_tpu.analysis import shapeflow  # noqa: F401
+    from gelly_streaming_tpu.analysis import staledisable  # noqa: F401
 
     return dict(_REGISTRY)
 
@@ -313,11 +328,60 @@ def _filter_suppressed(
 ) -> List[Finding]:
     out: List[Finding] = []
     for f in findings:
-        if sf.suppressed(f.line, f.code):
+        at = sf.suppressing_line(f.line, f.code)
+        if at is not None:
+            sf.used_suppressions.add(at)
             if keep_suppressed:
                 out.append(replace(f, suppressed=True))
         else:
             out.append(f)
+    return out
+
+
+def ran_codes_for(sf: SourceFile, passes: Sequence[Pass]) -> Set[str]:
+    """The finding codes the selected passes could have emitted for this
+    file's language — the universe the stale-suppression check judges a
+    ``# graft: disable=`` comment against."""
+    out: Set[str] = set()
+    for p in passes:
+        if sf.language in p.languages:
+            out.update(p.codes)
+    return out
+
+
+def stale_suppressions(
+    sf: SourceFile,
+    ran_codes: Set[str],
+    keep_suppressed: bool = False,
+) -> List[Finding]:
+    """STALEDISABLE: every ``# graft: disable=<CODE>`` comment that did not
+    silence a live finding this run, restricted to codes some selected
+    pass could actually have produced (a partial ``--select`` run must not
+    condemn another pass's suppressions).  Call AFTER every pass — file
+    and project alike — has reported, so ``used_suppressions`` is final."""
+    if sf.parse_error is not None or not ran_codes:
+        return []
+    out: List[Finding] = []
+    for lineno in sorted(sf.suppressions):
+        if lineno in sf.used_suppressions:
+            continue
+        codes = sf.suppressions[lineno]
+        live = sorted(codes & ran_codes) or (
+            sorted(ran_codes) if "*" in codes else []
+        )
+        if not live:
+            continue  # owning pass didn't run: not judgeable this run
+        shown = ",".join(sorted(codes - {"*"})) or "*"
+        f = sf.finding(
+            lineno,
+            "stale-disable",
+            "STALEDISABLE",
+            f"suppression 'graft: disable={shown}' no longer matches a "
+            "live finding on the line it governs — the defect moved or "
+            "was fixed; delete the comment (a stale disable will silently "
+            "swallow the next real finding here)",
+        )
+        out.extend(_filter_suppressed([f], sf, keep_suppressed))
     return out
 
 
@@ -336,11 +400,18 @@ def analyze_source(
     sf = SourceFile(text, path if path is not None else filename, filename)
     if sf.parse_error is not None:
         return [sf.finding(1, "analysis", "PARSE", sf.parse_error)]
+    ordinary = [p for p in passes if not p.post_check]
     out: List[Finding] = []
-    for p in passes:
+    for p in ordinary:
         if sf.language not in p.languages:
             continue
         out.extend(_filter_suppressed(p.run(sf), sf, keep_suppressed))
+    if any(p.post_check and sf.language in p.languages for p in passes):
+        out.extend(
+            stale_suppressions(
+                sf, ran_codes_for(sf, ordinary), keep_suppressed
+            )
+        )
     out.sort(key=lambda f: (f.path, f.line, f.code))
     return out
 
@@ -394,9 +465,12 @@ def _display_for(path: str, root: Optional[str]) -> str:
     return path if rel.startswith("..") else rel
 
 
-def _analyze_file_task(args) -> List[Finding]:
+def _analyze_file_task(args) -> Tuple[List[Finding], List[int]]:
     """Process-pool worker for ``--jobs``: re-resolves passes by name (pass
-    objects stay in-process) and runs the per-file passes over one file."""
+    objects stay in-process) and runs the per-file passes over one file.
+    Returns the findings plus the comment lines whose suppressions were
+    USED — the in-process stale-suppression check needs them, since the
+    worker's SourceFile (and its used map) dies with the process."""
     path, root, pass_names, keep_suppressed = args
     registry = load_passes()
     passes = [
@@ -404,9 +478,22 @@ def _analyze_file_task(args) -> List[Finding]:
         for n in pass_names
         if not isinstance(registry[n], ProjectPass)
     ]
-    return analyze_file(
-        path, passes, root=root, keep_suppressed=keep_suppressed
-    )
+    display = path
+    if root is not None:
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+        if not rel.startswith(".."):
+            display = rel
+    with open(path) as f:
+        sf = SourceFile(f.read(), path, display)
+    if sf.parse_error is not None:
+        return [sf.finding(1, "analysis", "PARSE", sf.parse_error)], []
+    out: List[Finding] = []
+    for p in passes:
+        if sf.language not in p.languages:
+            continue
+        out.extend(_filter_suppressed(p.run(sf), sf, keep_suppressed))
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out, sorted(sf.used_suppressions)
 
 
 def analyze_paths(
@@ -422,11 +509,19 @@ def analyze_paths(
     visible at all."""
     if passes is None:
         passes = list(load_passes().values())
-    file_passes = [p for p in passes if not isinstance(p, ProjectPass)]
-    project_passes = [p for p in passes if isinstance(p, ProjectPass)]
+    file_passes = [
+        p for p in passes
+        if not isinstance(p, ProjectPass) and not p.post_check
+    ]
+    project_passes = [
+        p for p in passes if isinstance(p, ProjectPass) and not p.post_check
+    ]
+    post_passes = [p for p in passes if p.post_check]
+    ordinary = file_passes + project_passes
     files = list(iter_source_files(paths))
     findings: List[Finding] = []
     parsed: Optional[List[SourceFile]] = None
+    worker_used: Dict[str, List[int]] = {}
     if jobs > 1 and len(files) > 1:
         import concurrent.futures
 
@@ -437,8 +532,11 @@ def analyze_paths(
         with concurrent.futures.ProcessPoolExecutor(
             max_workers=min(jobs, len(files))
         ) as pool:
-            for batch in pool.map(_analyze_file_task, tasks):
+            for path, (batch, used) in zip(
+                files, pool.map(_analyze_file_task, tasks)
+            ):
                 findings.extend(batch)
+                worker_used[path] = used
     else:
         # serial path: parse each file ONCE and reuse the SourceFiles for
         # the project passes below
@@ -458,27 +556,37 @@ def analyze_paths(
                 findings.extend(
                     _filter_suppressed(p.run(sf), sf, keep_suppressed)
                 )
-    if project_passes:
+    if project_passes or post_passes:
         from gelly_streaming_tpu.analysis import callgraph
 
         if parsed is None:  # --jobs: the workers parsed their own copies
             parsed = []
             for path in files:
                 with open(path) as f:
-                    parsed.append(
-                        SourceFile(f.read(), path, _display_for(path, root))
-                    )
+                    sf = SourceFile(f.read(), path, _display_for(path, root))
+                # fold in what the worker's copy of this file suppressed,
+                # so the stale check below sees the per-file passes' usage
+                sf.used_suppressions.update(worker_used.get(path, ()))
+                parsed.append(sf)
         sfs = [sf for sf in parsed if sf.tree is not None]
         by_path = {sf.display_path: sf for sf in sfs}
-        project = callgraph.Project(sfs)
-        for p in project_passes:
-            for f in p.run_project(project):
-                sf = by_path.get(f.path)
-                if sf is None:
-                    findings.append(f)
-                    continue
+        if project_passes:
+            project = callgraph.Project(sfs)
+            for p in project_passes:
+                for f in p.run_project(project):
+                    sf = by_path.get(f.path)
+                    if sf is None:
+                        findings.append(f)
+                        continue
+                    findings.extend(
+                        _filter_suppressed([f], sf, keep_suppressed)
+                    )
+        for sf in sfs if post_passes else ():
+            if any(sf.language in p.languages for p in post_passes):
                 findings.extend(
-                    _filter_suppressed([f], sf, keep_suppressed)
+                    stale_suppressions(
+                        sf, ran_codes_for(sf, ordinary), keep_suppressed
+                    )
                 )
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings

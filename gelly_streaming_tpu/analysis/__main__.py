@@ -34,6 +34,70 @@ def _resolve_paths(paths: List[str]) -> List[str]:
     return out
 
 
+def _sarif(passes, rows, grandfathered) -> dict:
+    """SARIF 2.1.0 document: one run, one rule per finding code, one result
+    per finding.  Comment-suppressed rows carry an ``inSource`` suppression
+    and baseline-grandfathered rows an ``external`` one, so SARIF viewers
+    (GitHub code scanning et al.) show them muted instead of dropping them.
+    """
+    rules = []
+    seen = set()
+    for p in passes.values():
+        for code in p.codes:
+            if code in seen:
+                continue
+            seen.add(code)
+            rules.append(
+                {
+                    "id": code,
+                    "name": code,
+                    "shortDescription": {"text": p.description},
+                    "properties": {"pass": p.name},
+                }
+            )
+    grandfathered_keys = {
+        (f.path, f.line, f.code, f.message) for f in grandfathered
+    }
+    results = []
+    for f in rows:
+        result = {
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line},
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            key = (f.path, f.line, f.code, f.message)
+            kind = "external" if key in grandfathered_keys else "inSource"
+            result["suppressions"] = [{"kind": kind}]
+        results.append(result)
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="gelly-analyze",
@@ -86,13 +150,16 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
         help="output format: 'text' (default, file:line: [PASS/CODE] "
-        "message) or 'json' — a stable machine-readable schema "
+        "message), 'json' — a stable machine-readable schema "
         "{findings: [{file,line,pass,code,message,suppressed}], summary} "
         "where comment-suppressed and baseline-grandfathered findings "
-        "appear with suppressed=true and do not fail the run",
+        "appear with suppressed=true and do not fail the run — or "
+        "'sarif' (SARIF 2.1.0, one run, one rule per finding code; "
+        "grandfathered/comment-suppressed findings carry "
+        "suppressions so CI viewers show them muted)",
     )
     parser.add_argument(
         "--jobs",
@@ -131,7 +198,7 @@ def main(argv=None) -> int:
         return 2
 
     root = os.path.dirname(analysis.package_root())
-    as_json = args.format == "json"
+    as_json = args.format in ("json", "sarif")
     findings = analysis.analyze_paths(
         paths,
         selected,
@@ -166,6 +233,9 @@ def main(argv=None) -> int:
             + comment_suppressed,
             key=lambda f: (f.path, f.line, f.code),
         )
+        if args.format == "sarif":
+            print(json.dumps(_sarif(passes, rows, grandfathered), indent=2))
+            return 1 if findings else 0
         print(
             json.dumps(
                 {

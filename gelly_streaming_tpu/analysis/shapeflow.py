@@ -1,0 +1,981 @@
+"""Pass 15 — ``shapeflow``: interprocedural shape-provenance prover for
+the 0-recompile guarantee.
+
+The streaming model only holds on TPU because every shape that reaches a
+compiled kernel is constant or pow2-bucketed (ROADMAP standing
+constraint).  Until now that was enforced at runtime, by bench pins on
+the handful of paths we benchmark; this pass proves it statically, for
+every compile boundary in the tree.
+
+Every size/shape-producing expression gets a PROVENANCE value from a
+four-point lattice, joined upward::
+
+    CONST  <  BUCKETED  <  UNKNOWN  <  DYNAMIC
+
+* ``CONST`` — literals, module-level constants, frozen config fields.
+* ``BUCKETED`` — flowed through a known bucketing construct: the pow2
+  idiom ``1 << (n - 1).bit_length()``, a helper in the bucketing
+  registry (``pow2_bucket``, ``frontier_caps``, ``bucket_shapes``,
+  ``plan_superbatch_groups``, ``bdv_bucket_nbytes``, ...), or any
+  project function whose summary proves its return bucketed.
+* ``UNKNOWN`` — unproven either way (attribute reads, unresolved
+  calls).  Absorbs all uncertainty; NEVER flagged — the pass only
+  reports what it can prove, so a finding is always actionable.
+* ``DYNAMIC`` — provably data-dependent: ``len()`` of a runtime value,
+  ``np.unique`` / ``nonzero`` / boolean-mask compression results, and
+  arithmetic over them.
+
+Values also carry the set of enclosing-function parameters they depend
+on, which is what makes the pass interprocedural on the callgraph
+engine: a function whose compile-cache key consumes parameter ``n``
+raw places an OBLIGATION on ``n``; every resolved call site (via
+``callgraph.Project.resolve_call``) must then prove its argument is not
+DYNAMIC, and obligations propagate transitively caller-ward to a
+fixpoint.  Return summaries flow the other way: a helper returning a
+pow2 round-up makes every call site BUCKETED without a registry entry.
+
+Compile boundaries checked:
+
+* ``cached_jit(key, build, ...)`` sites — every element of ``key``
+  (the SpMV pane builders, the fused-dispatch mega-fold, the pipeline
+  planes all route through these);
+* calls to compiled callables — names bound to ``cached_jit(...)`` /
+  ``jax.jit(...)`` results (module, local, or ``self.`` attribute) and
+  jit-decorated defs, including ``partial(jax.jit, ...)`` decorators.
+
+Finding codes:
+
+* ``UNBUCKETED`` — a DYNAMIC value reaches a compile boundary: a cache
+  key element, a static argument, or the shape of an array argument.
+  Each distinct runtime value mints a fresh executable — the
+  recompile-storm the runtime retrace guard (``recompiles()``) catches
+  only after the fact.
+* ``KEYLEAK`` — a ``cached_jit`` build closure reads an
+  enclosing-function local that the key omits: two calls with
+  different values silently share one traced program.
+* ``DTYPEDRIFT`` — a bare Python numeric literal crosses a cached
+  kernel boundary in a traced position: weak-type promotion forks cache
+  entries per promotion path and can flip output dtypes between
+  otherwise-identical dispatches.
+
+Shares the jit grammar (``_jit_decorator`` / ``_static_spec`` /
+``_is_cached_jit``) with pass #4 so the two layers cannot disagree on
+what a compile boundary is.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from gelly_streaming_tpu import analysis
+from gelly_streaming_tpu.analysis import callgraph
+from gelly_streaming_tpu.analysis.trace_safety import (
+    _is_cached_jit,
+    _jit_decorator,
+    _static_spec,
+)
+
+CONST, BUCKETED, UNKNOWN, DYNAMIC = range(4)
+
+#: size-bucketing helpers recognized by NAME when the call cannot be
+#: resolved to a summarized project function (cross-module attribute
+#: calls, re-exports); same-module helpers prove themselves via their
+#: return summaries instead
+_BUCKETING_NAMES = frozenset(
+    {
+        "pow2_bucket",
+        "bucket_shapes",
+        "frontier_caps",
+        "plan_superbatch_groups",
+        "bdv_bucket_nbytes",
+        "width_for_capacity",
+        "delta_capacity",
+        "shard_capacity",
+    }
+)
+
+#: np/jnp results whose SHAPE is data-dependent by construction
+_DYNAMIC_PRODUCERS = frozenset(
+    {"unique", "nonzero", "flatnonzero", "argwhere", "compress",
+     "setdiff1d", "union1d", "intersect1d"}
+)
+
+#: np/jnp array constructors whose first argument is the size/shape
+_ARRAY_CONSTRUCTORS = frozenset({"zeros", "ones", "empty", "full", "arange"})
+
+#: structural attributes whose value mirrors the base array's shape level
+_SHAPE_ATTRS = frozenset({"shape", "size", "nbytes"})
+
+_NUMPYISH = frozenset({"numpy", "jax"})  # leaf module names jnp/np/jax map to
+
+
+@dataclass(frozen=True)
+class Val:
+    """One lattice point: level, the enclosing-function parameter
+    indices it depends on, and whether the expression is array-valued
+    (for arrays the level describes the SHAPE, not the contents)."""
+
+    level: int
+    deps: FrozenSet[int] = frozenset()
+    array: bool = False
+
+    def join(self, other: "Val") -> "Val":
+        return Val(
+            max(self.level, other.level),
+            self.deps | other.deps,
+            self.array or other.array,
+        )
+
+
+V_CONST = Val(CONST)
+V_BUCKETED = Val(BUCKETED)
+V_UNKNOWN = Val(UNKNOWN)
+V_DYNAMIC = Val(DYNAMIC)
+
+#: (static_argnums, static_argnames) of a compiled-callable binding
+Spec = Tuple[Set[int], Set[str]]
+
+
+def _is_pow2_shift(node: ast.BinOp) -> bool:
+    """The pow2 round-up idiom: ``1 << (...).bit_length()``."""
+    return (
+        isinstance(node.op, ast.LShift)
+        and isinstance(node.left, ast.Constant)
+        and node.left.value == 1
+    )
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _numeric_literal(node: ast.AST) -> bool:
+    """A bare Python scalar literal (weak-typed when traced)."""
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        node = node.operand
+    return (
+        isinstance(node, ast.Constant)
+        and type(node.value) in (int, float)
+    )
+
+
+def _jax_aliases(mi: callgraph.ModuleInfo) -> Set[str]:
+    """Local names through which ``<name>.jit`` means ``jax.jit``."""
+    return {
+        alias
+        for alias, leaf in mi.import_aliases.items()
+        if leaf == "jax"
+    }
+
+
+def _is_jit_call(node: ast.Call, jax_names: Set[str]) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit":
+        return isinstance(fn.value, ast.Name) and fn.value.id in jax_names
+    return isinstance(fn, ast.Name) and fn.id == "jit"
+
+
+# ---------------------------------------------------------------------------
+# Module model: constants, code identities, compiled-callable bindings
+
+
+class _ModuleModel:
+    def __init__(self, mi: callgraph.ModuleInfo):
+        self.mi = mi
+        self.jax_names = _jax_aliases(mi)
+        #: module-level name -> Val (literal constants, pow2 globals)
+        self.consts: Dict[str, Val] = {}
+        #: names that denote CODE (defs, classes, imports): stable
+        #: identities, CONST in key expressions
+        self.code_names: Set[str] = set(mi.import_aliases)
+        self.code_names.update(mi.imported_names)
+        self.code_names.update(n for (_c, n) in mi.functions if _c is None)
+        self.code_names.update(mi.classes)
+        #: module-level compiled callables: name -> Spec
+        self.compiled: Dict[str, Spec] = {}
+        #: self-attribute compiled callables: (cls, attr) -> Spec
+        self.compiled_attrs: Dict[Tuple[str, str], Spec] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        tree = self.mi.sf.tree
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if not isinstance(t, ast.Name):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Constant) and not isinstance(
+                    v.value, (bytes,)
+                ):
+                    self.consts[t.id] = V_CONST
+                elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) for e in v.elts
+                ):
+                    self.consts[t.id] = V_CONST
+                elif isinstance(v, ast.BinOp) and _is_pow2_shift(v):
+                    self.consts[t.id] = V_BUCKETED
+                elif isinstance(v, ast.Call):
+                    spec = self._compiled_spec(v)
+                    if spec is not None:
+                        self.compiled[t.id] = spec
+        # self._kernel = cached_jit(...) bindings anywhere in a class body
+        for cls_name, cls_node in self.mi.classes.items():
+            for sub in ast.walk(cls_node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and isinstance(sub.value, ast.Call)
+                    ):
+                        spec = self._compiled_spec(sub.value)
+                        if spec is not None:
+                            self.compiled_attrs[(cls_name, t.attr)] = spec
+        # jit-decorated defs (incl. partial(jax.jit, ...)) are compiled
+        # callables at their own name
+        for (cls, name), fi in self.mi.functions.items():
+            for dec in getattr(fi.node, "decorator_list", []):
+                call = _jit_decorator(dec)
+                if call is not None:
+                    nums, names = _static_spec(call)
+                    if cls is None:
+                        self.compiled[name] = (nums, names)
+                    else:
+                        self.compiled_attrs[(cls, name)] = (nums, names)
+
+    def _compiled_spec(self, call: ast.Call) -> Optional[Spec]:
+        """The static spec if ``call`` mints a compiled callable."""
+        if _is_cached_jit(call):
+            return _cached_jit_spec(call)
+        if _is_jit_call(call, self.jax_names):
+            return _static_spec(call)
+        return None
+
+
+def _cached_jit_spec(call: ast.Call) -> Spec:
+    """static_argnums for a ``cached_jit`` site (it forwards the kwarg
+    verbatim to ``jax.jit``)."""
+    return _static_spec(call)
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+
+
+class _Eval:
+    """Evaluates expressions to lattice values inside one function (or
+    the module pseudo-function), against a local environment."""
+
+    def __init__(
+        self,
+        project: callgraph.Project,
+        model: "_ModuleModel",
+        models: Dict[str, "_ModuleModel"],
+        summaries: Dict[int, Val],
+        env: Dict[str, Val],
+        cls: Optional[str],
+        param_types: Dict[str, str],
+    ):
+        self.project = project
+        self.model = model
+        self.models = models
+        self.summaries = summaries
+        self.env = env
+        self.cls = cls
+        self.param_types = param_types
+
+    def eval(self, node: ast.AST) -> Val:
+        mi = self.model.mi
+        if isinstance(node, ast.Constant):
+            return V_CONST
+        if isinstance(node, ast.Name):
+            v = self.env.get(node.id)
+            if v is not None:
+                return v
+            v = self.model.consts.get(node.id)
+            if v is not None:
+                return v
+            if node.id in self.model.code_names:
+                return V_CONST  # functions/classes/modules: stable identity
+            return V_UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = V_CONST
+            for e in node.elts:
+                out = out.join(self.eval(e))
+            return out
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, ast.BinOp):
+            if _is_pow2_shift(node):
+                return V_BUCKETED
+            return self.eval(node.left).join(self.eval(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out = V_CONST
+            for e in node.values:
+                out = out.join(self.eval(e))
+            return out
+        if isinstance(node, ast.IfExp):
+            return self.eval(node.body).join(self.eval(node.orelse))
+        if isinstance(node, ast.Compare):
+            # a comparison VALUE is a cheap bool; its deps still matter
+            out = self.eval(node.left)
+            for c in node.comparators:
+                out = out.join(self.eval(c))
+            return Val(min(out.level, BUCKETED), out.deps)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                base = self.eval(node.value)
+                # the shape of an array mirrors the array's shape level
+                return Val(base.level, base.deps)
+            if node.attr in ("dtype", "ndim"):
+                return V_CONST  # bounded per abstract signature
+            return V_UNKNOWN
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Compare) or (
+                isinstance(sl, ast.Name)
+                and self.env.get(sl.id, V_CONST).array
+                and self.env[sl.id].level >= UNKNOWN
+            ):
+                # boolean-mask compression: arr[mask] / arr[x > 0]
+                return Val(DYNAMIC, array=True)
+            base = self.eval(node.value)
+            if not base.array:
+                # CONST_TABLE[i] / caps[j]: an element of a bucketed or
+                # constant table stays at the table's level
+                return Val(base.level, base.deps | self.eval(sl).deps)
+            return V_UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # a comprehension's LENGTH mirrors its iterable's; an ``if``
+            # clause is boolean compression — data-dependent by definition
+            out = V_CONST
+            for gen in node.generators:
+                if gen.ifs:
+                    return Val(DYNAMIC, self.eval(gen.iter).deps)
+                out = out.join(self.eval(gen.iter))
+            return Val(out.level, out.deps)
+        if isinstance(node, (ast.Dict, ast.Lambda)):
+            return V_UNKNOWN
+        if isinstance(node, ast.JoinedStr):
+            out = V_CONST
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    out = out.join(self.eval(v.value))
+            return out
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.eval(node.value)
+        return V_UNKNOWN
+
+    def _eval_call(self, node: ast.Call) -> Val:
+        name = _call_name(node)
+        if name == "len" and node.args:
+            # a container's length mirrors its provenance: CONST tuple ->
+            # CONST, filtered comprehension -> DYNAMIC, array -> its
+            # shape level; parameters keep their dep so the obligation
+            # fixpoint judges the caller's container instead
+            inner = self.eval(node.args[0])
+            return Val(inner.level, inner.deps)
+        if name in ("list", "tuple", "sorted", "set", "range", "reversed"):
+            out = V_CONST
+            for a in node.args:
+                out = out.join(self.eval(a))
+            return Val(out.level, out.deps)
+        if name in ("int", "float", "abs", "round") and node.args:
+            v = self.eval(node.args[0])
+            return Val(v.level, v.deps)
+        if name in ("min", "max"):
+            out = V_CONST
+            for a in node.args:
+                out = out.join(self.eval(a))
+            return Val(out.level, out.deps)
+        if name == "str" and node.args:
+            v = self.eval(node.args[0])
+            return Val(v.level, v.deps)
+        if name in _DYNAMIC_PRODUCERS:
+            return Val(DYNAMIC, array=True)
+        if name == "where" and len(node.args) == 1:
+            return Val(DYNAMIC, array=True)  # 1-arg where == nonzero
+        if name == "sum" and node.args:
+            inner = node.args[0]
+            if isinstance(inner, ast.Compare):
+                # popcount of a predicate: the classic frontier size
+                return V_DYNAMIC
+            v = self.eval(inner)
+            return Val(DYNAMIC if v.array and v.level >= UNKNOWN else v.level,
+                       v.deps)
+        if name in _ARRAY_CONSTRUCTORS and self._is_numpyish(node):
+            if node.args:
+                size = self.eval(node.args[0])
+                return Val(size.level, size.deps, array=True)
+            return Val(UNKNOWN, array=True)
+        if name in _BUCKETING_NAMES:
+            return V_BUCKETED
+        fi = self.project.resolve_call(
+            self.model.mi, self.cls, node, self.param_types
+        )
+        if fi is not None:
+            summary = self.summaries.get(id(fi))
+            if summary is not None:
+                out = Val(summary.level, frozenset(), summary.array)
+                params = _param_names(fi.node)
+                for i in summary.deps:
+                    if i < len(node.args):
+                        out = out.join(self.eval(node.args[i]))
+                    elif i < len(params):
+                        for kw in node.keywords:
+                            if kw.arg == params[i]:
+                                out = out.join(self.eval(kw.value))
+                return out
+        return V_UNKNOWN
+
+    def _is_numpyish(self, node: ast.Call) -> bool:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            leaf = self.model.mi.import_aliases.get(fn.value.id)
+            return leaf in _NUMPYISH or leaf == "numpy"
+        return isinstance(fn, ast.Name) and fn.id in self.model.mi.imported_names
+
+
+def _param_names(func: ast.AST) -> List[str]:
+    args = func.args
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+# ---------------------------------------------------------------------------
+# Per-function analysis
+
+
+class _FuncScope:
+    """One function (or the module pseudo-scope): builds the local
+    environment in source order, then walks the body for boundaries."""
+
+    def __init__(
+        self,
+        project: callgraph.Project,
+        model: _ModuleModel,
+        models: Dict[str, _ModuleModel],
+        summaries: Dict[int, Val],
+        fi: Optional[callgraph.FuncInfo],
+        body: Sequence[ast.stmt],
+    ):
+        self.project = project
+        self.model = model
+        self.fi = fi
+        self.body = body
+        self.cls = fi.cls if fi is not None else None
+        self.env: Dict[str, Val] = {}
+        self.compiled: Dict[str, Spec] = {}
+        self.local_defs: Dict[str, ast.AST] = {}
+        #: name -> every expression assigned to it (KEYLEAK traces key
+        #: coverage through intermediate locals: ``key = (..., cap)``)
+        self.binds: Dict[str, List[ast.AST]] = {}
+        self.param_names: List[str] = (
+            _param_names(fi.node) if fi is not None else []
+        )
+        param_types = (
+            project.param_types_of(fi) if fi is not None else {}
+        )
+        for i, p in enumerate(self.param_names):
+            if p != "self":
+                self.env[p] = Val(CONST, frozenset({i}))
+        if fi is not None:
+            a = fi.node.args
+            for kw in a.kwonlyargs:
+                self.env[kw.arg] = V_UNKNOWN
+        self.ev = _Eval(
+            project, model, models, summaries, self.env, self.cls,
+            param_types,
+        )
+        self._skip: Set[int] = set()  # nested def/lambda subtrees
+        for stmt in body:
+            self._collect_skips(stmt)
+        # two passes so values reaching a loop header from the loop body
+        # (accumulators, rebinds) stabilize
+        self._record_binds = True
+        for _ in range(2):
+            for stmt in body:
+                self._bind_stmt(stmt)
+            self._record_binds = False
+
+    def _collect_skips(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self.fi is None or sub is not self.fi.node:
+                    self.local_defs.setdefault(sub.name, sub)
+                    self._skip.update(id(d) for d in ast.walk(sub))
+            elif isinstance(sub, ast.ClassDef) and self.fi is None:
+                self._skip.update(id(d) for d in ast.walk(sub))
+
+    # -- environment -------------------------------------------------------
+
+    def _bind_stmt(self, node: ast.AST) -> None:
+        if id(node) in self._skip:
+            return
+        if isinstance(node, ast.Assign) and len(node.targets) >= 1:
+            if isinstance(node.value, ast.Call):
+                spec = self.model._compiled_spec(node.value)
+                if spec is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.compiled[t.id] = spec
+            v = self.ev.eval(node.value)
+            for t in node.targets:
+                self._bind_target(t, v, node.value)
+                if self._record_binds and isinstance(t, ast.Name):
+                    self.binds.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._bind_target(node.target, self.ev.eval(node.value),
+                              node.value)
+            if self._record_binds and isinstance(node.target, ast.Name):
+                self.binds.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                old = self.env.get(node.target.id, V_CONST)
+                self.env[node.target.id] = old.join(self.ev.eval(node.value))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._bind_target(node.target, V_UNKNOWN, None)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, V_UNKNOWN, None)
+        for name in ("body", "orelse", "finalbody"):
+            block = getattr(node, name, None)
+            if isinstance(block, list):
+                for sub in block:
+                    if isinstance(sub, ast.stmt):
+                        self._bind_stmt(sub)
+        for handler in getattr(node, "handlers", []) or []:
+            if isinstance(handler, ast.ExceptHandler):
+                for sub in handler.body:
+                    self._bind_stmt(sub)
+        for case in getattr(node, "cases", []) or []:
+            for sub in getattr(case, "body", []) or []:
+                self._bind_stmt(sub)
+
+    def _bind_target(
+        self, t: ast.AST, v: Val, value: Optional[ast.AST]
+    ) -> None:
+        if isinstance(t, ast.Name):
+            self.env[t.id] = v  # last write in source order wins
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._bind_target(e, Val(v.level, v.deps), value)
+
+    # -- boundary walk -----------------------------------------------------
+
+    def boundary_calls(self):
+        """Yield every Call in this scope's own statements (nested defs
+        excluded: they are scopes of their own)."""
+        for stmt in self.body:
+            for node in ast.walk(stmt):
+                if id(node) in self._skip:
+                    continue
+                if isinstance(node, ast.Call):
+                    yield node
+
+    def spec_for_call(self, call: ast.Call) -> Optional[Spec]:
+        """The static spec if ``call`` invokes a compiled callable."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            spec = self.compiled.get(fn.id)
+            if spec is not None:
+                return spec
+            return self.model.compiled.get(fn.id)
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id == "self"
+                and self.cls is not None
+            ):
+                return self.model.compiled_attrs.get((self.cls, fn.attr))
+            if isinstance(base, ast.Name):
+                leaf = self.model.mi.import_aliases.get(base.id)
+                other = self.ev.models.get(leaf) if leaf else None
+                if other is not None:
+                    return other.compiled.get(fn.attr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The pass
+
+
+class ShapeflowPass(analysis.ProjectPass):
+    name = "shapeflow"
+    codes = ("UNBUCKETED", "KEYLEAK", "DTYPEDRIFT")
+    description = (
+        "prove every shape at a compile boundary CONST or pow2-BUCKETED"
+    )
+
+    def run_project(self, project) -> List[analysis.Finding]:
+        models: Dict[str, _ModuleModel] = {}
+        for mi in project.module_list:
+            if os.path.basename(mi.path) == "compile_cache.py":
+                continue  # the sanctioned wrapper defines the boundary
+            models[mi.name] = _ModuleModel(mi)
+        summaries = self._summaries(project, models)
+        #: id(FuncInfo) -> obligated param indices (raw flow into a key)
+        obligations: Dict[int, Set[int]] = {}
+        # obligation fixpoint first (no findings), then one reporting pass
+        for _ in range(12):
+            changed = self._sweep(
+                project, models, summaries, obligations, findings=None
+            )
+            if not changed:
+                break
+        findings: List[analysis.Finding] = []
+        self._sweep(project, models, summaries, obligations, findings)
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        # a boundary inside a loop body is walked once per enclosing
+        # scope; dedupe identical reports
+        seen: Set[Tuple[str, int, str, str]] = set()
+        out = []
+        for f in findings:
+            key = (f.path, f.line, f.code, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    # -- return summaries --------------------------------------------------
+
+    def _summaries(
+        self, project, models: Dict[str, _ModuleModel]
+    ) -> Dict[int, Val]:
+        """Fixpoint over return expressions: FuncInfo -> Val with deps
+        as the function's OWN param indices (bind params CONST+dep, so
+        the residual level is the body's contribution alone)."""
+        summaries: Dict[int, Val] = {}
+        funcs = [
+            fi
+            for model in models.values()
+            for fi in model.mi.functions.values()
+        ]
+        for _ in range(6):
+            changed = False
+            for fi in funcs:
+                model = models[fi.module.name]
+                scope = _FuncScope(
+                    project, model, models, summaries, fi, fi.node.body
+                )
+                out: Optional[Val] = None
+                for node in ast.walk(fi.node):
+                    if id(node) in scope._skip:
+                        continue
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        v = scope.ev.eval(node.value)
+                        out = v if out is None else out.join(v)
+                if out is None:
+                    out = V_CONST  # returns nothing size-like
+                if summaries.get(id(fi)) != out:
+                    summaries[id(fi)] = out
+                    changed = True
+            if not changed:
+                break
+        return summaries
+
+    # -- the sweep ---------------------------------------------------------
+
+    def _sweep(
+        self,
+        project,
+        models: Dict[str, _ModuleModel],
+        summaries: Dict[int, Val],
+        obligations: Dict[int, Set[int]],
+        findings: Optional[List[analysis.Finding]],
+    ) -> bool:
+        changed = False
+        for model in models.values():
+            mi = model.mi
+            scopes: List[_FuncScope] = []
+            if mi.sf.tree is not None:
+                scopes.append(
+                    _FuncScope(project, model, models, summaries, None,
+                               mi.sf.tree.body)
+                )
+            for fi in list(mi.functions.values()) + list(mi.nested):
+                scopes.append(
+                    _FuncScope(project, model, models, summaries, fi,
+                               fi.node.body)
+                )
+            for scope in scopes:
+                if self._check_scope(
+                    project, scope, summaries, obligations, findings
+                ):
+                    changed = True
+        return changed
+
+    def _check_scope(
+        self,
+        project,
+        scope: _FuncScope,
+        summaries: Dict[int, Val],
+        obligations: Dict[int, Set[int]],
+        findings: Optional[List[analysis.Finding]],
+    ) -> bool:
+        sf = scope.model.mi.sf
+        changed = False
+
+        def oblige(deps: FrozenSet[int]) -> bool:
+            if scope.fi is None or not deps:
+                return False
+            have = obligations.setdefault(id(scope.fi), set())
+            fresh = deps - have
+            if fresh:
+                have.update(fresh)
+                return True
+            return False
+
+        for call in scope.boundary_calls():
+            if _is_cached_jit(call) and call.args:
+                self._check_cached_jit(scope, call, findings)
+                key = call.args[0]
+                elts = key.elts if isinstance(key, ast.Tuple) else [key]
+                for elt in elts:
+                    v = scope.ev.eval(elt)
+                    if v.level == DYNAMIC:
+                        if findings is not None:
+                            findings.append(sf.finding(
+                                elt.lineno,
+                                self.name,
+                                "UNBUCKETED",
+                                "data-dependent value in a compile-cache "
+                                "key — every distinct runtime value mints "
+                                "a fresh executable (recompile storm); "
+                                "round it through a pow2 bucket helper "
+                                "first",
+                            ))
+                    elif oblige(v.deps):
+                        changed = True
+                continue
+            spec = scope.spec_for_call(call)
+            if spec is not None:
+                self._check_compiled_call(scope, call, spec, findings)
+            # obligation propagation through resolved project calls
+            fi = project.resolve_call(
+                scope.model.mi, scope.cls, call, scope.ev.param_types
+            )
+            if fi is None:
+                continue
+            obliged = obligations.get(id(fi))
+            if not obliged:
+                continue
+            params = _param_names(fi.node)
+            for i in sorted(obliged):
+                arg: Optional[ast.AST] = None
+                if i < len(call.args):
+                    arg = call.args[i]
+                elif i < len(params):
+                    for kw in call.keywords:
+                        if kw.arg == params[i]:
+                            arg = kw.value
+                if arg is None:
+                    continue
+                v = scope.ev.eval(arg)
+                if v.level == DYNAMIC:
+                    if findings is not None:
+                        findings.append(sf.finding(
+                            arg.lineno,
+                            self.name,
+                            "UNBUCKETED",
+                            "data-dependent value flows into parameter "
+                            f"'{params[i]}' of {fi.qualname()}(), which "
+                            "feeds a compile-cache key — every distinct "
+                            "runtime value mints a fresh executable; "
+                            "bucket it before the call",
+                        ))
+                elif oblige(v.deps):
+                    changed = True
+        return changed
+
+    # -- per-boundary checks -----------------------------------------------
+
+    def _check_cached_jit(
+        self,
+        scope: _FuncScope,
+        call: ast.Call,
+        findings: Optional[List[analysis.Finding]],
+    ) -> bool:
+        """KEYLEAK: build closure reads an enclosing local the key
+        omits."""
+        if findings is None or len(call.args) < 2:
+            return False
+        sf = scope.model.mi.sf
+        build = call.args[1]
+        if isinstance(build, ast.Lambda):
+            body: Optional[ast.AST] = build
+        elif isinstance(build, ast.Name) and build.id in scope.local_defs:
+            body = scope.local_defs[build.id]
+        else:
+            # module-level builds close over module globals: stable
+            return False
+        frees = _free_loads(body)
+        key_names = {
+            n.id for n in ast.walk(call.args[0]) if isinstance(n, ast.Name)
+        }
+        # keys are often assembled through intermediate locals
+        # (``key_tail = (cap, ...)``; ``identity = kernel_key or kernel``):
+        # expand key coverage through every binding of every key name
+        work = list(key_names)
+        while work:
+            for expr in scope.binds.get(work.pop(), ()):
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Name) and sub.id not in key_names:
+                        key_names.add(sub.id)
+                        work.append(sub.id)
+        import builtins
+
+        derived_ok = key_names | scope.model.code_names | {"self"}
+        for name in sorted(frees):
+            if name in key_names or name == "self":
+                continue
+            v = scope.env.get(name)
+            if v is None:
+                continue  # not an enclosing-scope local
+            if name in scope.compiled or name in scope.local_defs:
+                continue  # code identity, not data
+            if v.level == CONST and not v.deps:
+                continue  # a literal local cannot vary across calls
+            exprs = scope.binds.get(name)
+            if exprs and all(
+                all(
+                    not isinstance(s, ast.Name)
+                    or s.id in derived_ok
+                    or hasattr(builtins, s.id)
+                    for s in ast.walk(e)
+                )
+                for e in exprs
+            ):
+                # every binding derives purely from key'd values / stable
+                # code identities (``stages = stream._stages`` with the
+                # key carrying ``stream._stages``)
+                continue
+            findings.append(sf.finding(
+                build.lineno,
+                self.name,
+                "KEYLEAK",
+                f"cached_jit build closes over local '{name}' but the "
+                "key omits it — two calls with different values "
+                "silently share one traced program; add it (or a "
+                "stable token for it) to the key tuple",
+            ))
+        return True
+
+    def _check_compiled_call(
+        self,
+        scope: _FuncScope,
+        call: ast.Call,
+        spec: Spec,
+        findings: Optional[List[analysis.Finding]],
+    ) -> None:
+        if findings is None:
+            return
+        sf = scope.model.mi.sf
+        static_nums, static_names = spec
+        for i, arg in enumerate(call.args):
+            v = scope.ev.eval(arg)
+            if i in static_nums:
+                if v.level == DYNAMIC:
+                    findings.append(sf.finding(
+                        arg.lineno,
+                        self.name,
+                        "UNBUCKETED",
+                        "data-dependent value in a STATIC argument of a "
+                        "compiled kernel — jax retraces once per distinct "
+                        "value; bucket it or make it traced",
+                    ))
+                continue
+            if _numeric_literal(arg):
+                findings.append(sf.finding(
+                    arg.lineno,
+                    self.name,
+                    "DTYPEDRIFT",
+                    "bare Python scalar crosses a cached kernel boundary "
+                    "— weak-type promotion forks cache entries and can "
+                    "flip output dtypes; wrap it (jnp.asarray(x, dtype)) "
+                    "or declare the position static",
+                ))
+            elif v.array and v.level == DYNAMIC:
+                findings.append(sf.finding(
+                    arg.lineno,
+                    self.name,
+                    "UNBUCKETED",
+                    "array with data-dependent shape passed to a "
+                    "compiled kernel — each distinct size compiles a "
+                    "fresh executable; pad to a pow2 bucket first",
+                ))
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in static_names:
+                continue
+            if kw.arg is None:
+                continue
+            if _numeric_literal(kw.value):
+                findings.append(sf.finding(
+                    kw.value.lineno,
+                    self.name,
+                    "DTYPEDRIFT",
+                    "bare Python scalar crosses a cached kernel boundary "
+                    "— weak-type promotion forks cache entries and can "
+                    "flip output dtypes; wrap it (jnp.asarray(x, dtype)) "
+                    "or declare the position static",
+                ))
+
+
+def _free_loads(node: ast.AST) -> Set[str]:
+    """Names loaded in ``node`` but not bound inside it (params,
+    assignment/comprehension targets)."""
+    bound: Set[str] = set()
+    loads: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            a = sub.args
+            bound.update(
+                x.arg
+                for x in list(a.posonlyargs) + list(a.args)
+                + list(a.kwonlyargs)
+            )
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(sub.name)
+        elif isinstance(sub, ast.Name):
+            if isinstance(sub.ctx, ast.Store):
+                bound.add(sub.id)
+            else:
+                loads.add(sub.id)
+        elif isinstance(sub, ast.comprehension):
+            for n in ast.walk(sub.target):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+    import builtins
+
+    return {
+        n for n in loads - bound if not hasattr(builtins, n)
+    }
+
+
+analysis.register(ShapeflowPass())

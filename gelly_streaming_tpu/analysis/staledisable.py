@@ -1,0 +1,36 @@
+"""Pass 16 — stale-suppression audit (STALEDISABLE).
+
+A ``# graft: disable=<CODE>`` comment that no longer silences a live
+finding is worse than dead weight: it will silently swallow the NEXT
+real finding introduced on that line.  This pass flags every disable
+comment that went unused in the current run, restricted to codes some
+selected pass could actually have produced (so a partial ``--select``
+run never condemns another pass's suppressions).
+
+The detection itself lives in the framework (``stale_suppressions`` in
+``analysis/__init__.py``) because it must observe every other pass's
+suppression hits — file and project passes alike — before judging.
+This module only registers the pass object that switches the check on
+(``post_check=True``); its ``run`` is never consulted for findings.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from gelly_streaming_tpu.analysis import Finding, Pass, SourceFile, register
+
+
+class StaleDisablePass(Pass):
+    name = "stale-disable"
+    codes = ("STALEDISABLE",)
+    languages = ("python", "cpp")
+    post_check = True
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        # findings are produced by the framework's post-check hook, which
+        # runs after used_suppressions is final; nothing to do per-file
+        return []
+
+
+register(StaleDisablePass())

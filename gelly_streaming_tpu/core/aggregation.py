@@ -37,8 +37,7 @@ from gelly_streaming_tpu.core.windows import (
 from gelly_streaming_tpu.utils import metrics, tracing
 
 
-@jax.jit
-def _tree_copy(tree):
+def _tree_copy_impl(tree):
     """On-device clone of a pytree.
 
     Outputs of a jit call never alias its (non-donated) inputs, so the clone
@@ -46,6 +45,12 @@ def _tree_copy(tree):
     the invariant async snapshots rely on.
     """
     return jax.tree.map(jnp.copy, tree)
+
+
+# one executable per pytree structure, shared process-wide and metered by
+# the retrace guard (the structure is part of jit's own signature; the key
+# names the kernel family)
+_tree_copy = compile_cache.cached_jit(("tree_copy",), lambda: _tree_copy_impl)
 
 
 def resolve_fused_dispatch(cfg: StreamConfig) -> bool:

@@ -223,7 +223,7 @@ def pipelined_pane_counts(
     return counts
 
 
-from functools import partial
+from gelly_streaming_tpu.core import compile_cache
 
 
 def _superpane_count_fn(k: int, e_pad: int, num_vertices: int, max_deg: int):
@@ -319,8 +319,7 @@ def _superbatched_window_counts(panes, k: int):
             yield counts[i], pane.max_timestamp
 
 
-@partial(jax.jit, static_argnums=(2, 3))
-def _count_kernel(u: jax.Array, v: jax.Array, num_vertices: int, max_deg: int):
+def _count_kernel_impl(u: jax.Array, v: jax.Array, num_vertices: int, max_deg: int):
     """sum over edges |N(u) & N(v)| / 3 with a padded-CSR equality reduction."""
     e = u.shape[0]
     table = nbr_ops.init_table(num_vertices, max_deg)
@@ -337,6 +336,13 @@ def _count_kernel(u: jax.Array, v: jax.Array, num_vertices: int, max_deg: int):
         & valid_v[:, None, :]
     )
     return jnp.sum(eq.astype(jnp.int32)) // 3
+
+
+# shared executable for the per-pane count: (num_vertices, max_deg) are
+# pow2-bucketed by the caller, so each bucket compiles once process-wide
+_count_kernel = compile_cache.cached_jit(
+    ("tri_count_kernel",), lambda: _count_kernel_impl, static_argnums=(2, 3)
+)
 
 
 def window_triangles(

@@ -131,7 +131,11 @@ def build_buckets(src, dst, val, mask) -> List[NeighborhoodBucket]:
 
 
 # the shared jitted instance (one compile cache for every caller:
-# core/snapshot.py pane builds, library/kcore.py, ...)
-import jax as _jax
+# core/snapshot.py pane builds, library/kcore.py, ...), routed through the
+# process-global executable cache so its compiles are metered by the
+# retrace guard
+from gelly_streaming_tpu.core import compile_cache
 
-build_buckets_jit = _jax.jit(build_buckets)
+build_buckets_jit = compile_cache.cached_jit(
+    ("nbr_build_buckets",), lambda: build_buckets
+)

@@ -1,10 +1,15 @@
 """Seeded bug: raw jax.jit call sites bypassing the executable cache.
 
-Expected findings: exactly two RAWJIT (decorator + call form).
+Expected findings: exactly four RAWJIT — the decorator form, the call
+form, the ``import jax as _jax`` alias that used to slip past the name
+match, and the ``partial(jax.jit, ...)`` decorator-with-kwargs operand.
 This file is analyzer input only — it is never imported.
 """
 
+from functools import partial
+
 import jax
+import jax as _jax
 
 
 @jax.jit
@@ -14,3 +19,11 @@ def kernel(x):
 
 def make_stream_step(state_fn):
     return jax.jit(state_fn, donate_argnums=0)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def bucketed_kernel(x, width):
+    return x[:width]
+
+
+aliased_step = _jax.jit(lambda x: x * 2)

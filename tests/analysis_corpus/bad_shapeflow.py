@@ -1,0 +1,79 @@
+"""Seeded bugs: data-dependent shapes crossing compile boundaries.
+
+Expected findings (shapeflow): UNBUCKETED at the data-dependent
+compile-cache key, UNBUCKETED at the interprocedural call site whose
+argument feeds a callee's key, KEYLEAK for the closed-over scale the key
+omits, and DTYPEDRIFT for the bare Python scalar crossing the cached
+kernel boundary.
+
+Unlike most corpus files this one IS imported: tests/test_shapeflow.py
+loads it and drives ``unbucketed_step`` to prove the seeded UNBUCKETED
+really recompiles (compile_cache stats), so module import must stay
+side-effect-free — functions only, nothing called at module scope.
+"""
+
+import numpy as np
+
+from gelly_streaming_tpu.core import compile_cache
+
+
+def _build_fold():
+    import jax.numpy as jnp
+
+    def fold(x):
+        return jnp.sum(x)
+
+    return fold
+
+
+def unbucketed_step(values):
+    # the live count is data-dependent: every distinct batch mints a
+    # fresh executable
+    live = [v for v in values if v > 0.0]
+    n = len(live)
+    fn = compile_cache.cached_jit(("bad_fold", n), _build_fold)
+    import jax.numpy as jnp
+
+    return fn(jnp.zeros((max(n, 1),), jnp.float32))
+
+
+def _fold_for(n):
+    return compile_cache.cached_jit(("bad_interp_fold", n), _build_fold)
+
+
+def interp_step(v):
+    # the dynamic unique-count flows INTO _fold_for's key: only the
+    # interprocedural obligation flow can see it from this line
+    return _fold_for(len(np.unique(v)))
+
+
+def make_scaled_fold(scale):
+    def build():
+        import jax.numpy as jnp
+
+        def fold(x):
+            return jnp.sum(x) * scale
+
+        return fold
+
+    # the key omits `scale`, so two folds with different scales collide
+    # on one cache entry and silently share the first one's executable
+    return compile_cache.cached_jit(("bad_scaled_fold",), build)
+
+
+def _build_scaled():
+    import jax.numpy as jnp
+
+    def fold(x, s):
+        return jnp.sum(x) * s
+
+    return fold
+
+
+_drift_fold = compile_cache.cached_jit(("bad_drift_fold",), _build_scaled)
+
+
+def drift_step(x):
+    # bare Python float crosses the cached boundary: weak-type promotion
+    # forks cache entries by call-site literal
+    return _drift_fold(x, 0.5)

@@ -1,0 +1,22 @@
+"""Seeded bug: a suppression that outlived the finding it silenced.
+
+The kernel below was rewired through the executable cache, but the
+``# graft: disable=RAWJIT`` comment stayed behind — it now suppresses
+nothing, and would invisibly swallow a FUTURE raw jit added on its line.
+
+Expected findings: exactly one STALEDISABLE.
+This file is analyzer input only — it is never imported.
+"""
+
+from gelly_streaming_tpu.core import compile_cache
+
+
+def _make():
+    def kernel(x):
+        return x + 1
+
+    return kernel
+
+
+# graft: disable=RAWJIT — predates the cached_jit rewire below
+step = compile_cache.cached_jit(("stale_corpus_kernel",), _make)

@@ -1,0 +1,78 @@
+"""Clean counterpart: every shape at a compile boundary is CONST or
+pow2-BUCKETED, every closed-over value is in the key, and no bare scalar
+crosses a cached kernel boundary.
+
+Expected findings: none.  Imported by tests/test_shapeflow.py: the
+runtime cross-check drives ``bucketed_step`` over the same batch sizes
+as the bad twin's ``unbucketed_step`` and asserts zero recompiles.
+"""
+
+import numpy as np
+
+from gelly_streaming_tpu.core import compile_cache
+
+
+def pow2_bucket(n):
+    """Next power of two >= n (>= 1): the shape-class rounding that keeps
+    successive panes on one executable."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def _build_fold():
+    import jax.numpy as jnp
+
+    def fold(x):
+        return jnp.sum(x)
+
+    return fold
+
+
+def bucketed_step(values):
+    live = [v for v in values if v > 0.0]
+    cap = pow2_bucket(max(len(live), 1))
+    fn = compile_cache.cached_jit(("good_fold", cap), _build_fold)
+    import jax.numpy as jnp
+
+    return fn(jnp.zeros((cap,), jnp.float32))
+
+
+def _fold_for(n):
+    return compile_cache.cached_jit(("good_interp_fold", n), _build_fold)
+
+
+def interp_step(v):
+    # the unique-count is rounded through the bucket helper BEFORE it
+    # reaches the callee's key
+    return _fold_for(pow2_bucket(len(np.unique(v))))
+
+
+def make_scaled_fold(scale):
+    def build():
+        import jax.numpy as jnp
+
+        def fold(x):
+            return jnp.sum(x) * scale
+
+        return fold
+
+    # scale is in the key: distinct scales get distinct entries
+    return compile_cache.cached_jit(("good_scaled_fold", scale), build)
+
+
+def _build_scaled():
+    import jax.numpy as jnp
+
+    def fold(x, s):
+        return jnp.sum(x) * s
+
+    return fold
+
+
+_drift_fold = compile_cache.cached_jit(("good_drift_fold",), _build_scaled)
+
+
+def drift_step(x):
+    import jax.numpy as jnp
+
+    # dtype pinned at the call site: no weak-type fork
+    return _drift_fold(x, jnp.float32(0.5))

@@ -1,0 +1,13 @@
+"""Clean counterpart: a suppression that still earns its keep.
+
+The raw jit here is deliberate (a cold diagnostic probe outside the hot
+dispatch plane), the disable comment silences a LIVE RAWJIT finding, so
+the stale-disable post-check leaves it alone.
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import jax
+
+# cold path: a one-shot self-test probe, never re-created per stream
+probe = jax.jit(lambda x: x)  # graft: disable=RAWJIT — cold diagnostic probe

@@ -1,0 +1,155 @@
+"""shapeflow (tier-1): the interprocedural shape-provenance prover behind
+the 0-recompile guarantee.
+
+Three layers:
+
+* the FIXTURE CORPUS — bad_shapeflow.py seeds all three finding codes
+  (UNBUCKETED at a key, UNBUCKETED through an interprocedural call,
+  KEYLEAK, DTYPEDRIFT) and the good twin — same kernels, shapes rounded
+  through a pow2 bucket, keys complete, dtypes pinned — scans clean;
+* the REAL TREE — the SpMV pane builders and the fused-dispatch plane
+  (the two hottest compile-boundary surfaces) hold zero non-grandfathered
+  shapeflow findings;
+* the RUNTIME CROSS-CHECK — the prover's verdict is not just a lint
+  opinion: driving the seeded UNBUCKETED repro through a small
+  compile_cache really recompiles (stats say so), while the bucketed twin
+  over the SAME batch sizes stays at zero.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from gelly_streaming_tpu import analysis
+
+CORPUS = os.path.join(os.path.dirname(__file__), "analysis_corpus")
+REPO_ROOT = os.path.dirname(analysis.package_root())
+
+
+def _analyze(path):
+    return analysis.analyze_file(os.path.join(CORPUS, path))
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus
+
+
+def test_corpus_shapeflow():
+    findings = _analyze("bad_shapeflow.py")
+    assert _codes(findings) == [
+        "DTYPEDRIFT",
+        "KEYLEAK",
+        "UNBUCKETED",
+        "UNBUCKETED",
+        "UNBUCKETED",
+    ]
+    msgs = "\n".join(f.message for f in findings)
+    # the three UNBUCKETED flavors: key element, compiled-call array
+    # argument, and the interprocedural obligation at the caller
+    assert "compile-cache key" in msgs
+    assert "data-dependent shape passed to a compiled kernel" in msgs
+    assert "_fold_for" in msgs and "parameter 'n'" in msgs
+    assert "closes over local 'scale'" in msgs
+    assert "bare Python scalar" in msgs
+    assert _analyze("good_shapeflow.py") == []
+
+
+def test_corpus_staledisable():
+    findings = _analyze("bad_staledisable.py")
+    assert _codes(findings) == ["STALEDISABLE"]
+    assert "graft: disable=RAWJIT" in findings[0].message
+    assert _analyze("good_staledisable.py") == []
+
+
+def test_shapeflow_cases_invisible_to_trace_safety():
+    """The acceptance proof: the seeded provenance defects are INVISIBLE
+    to the intraprocedural trace-safety pass — shapeflow's lattice +
+    obligation flow is the only thing standing between them and a
+    recompile storm in production."""
+    p5 = [analysis.load_passes()["trace-safety"]]
+    findings = analysis.analyze_file(
+        os.path.join(CORPUS, "bad_shapeflow.py"), p5
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# real tree: the hot compile-boundary surfaces prove clean
+
+
+def test_spmv_and_fused_dispatch_prove_clean():
+    """ops/spmv.py (masked-semiring pane kernels) and core/aggregation.py
+    (the fused-dispatch mega-fold and its wire/scan/pane builders) carry
+    the densest compile boundaries in the tree: the prover must hold them
+    at zero non-grandfathered findings."""
+    root = analysis.package_root()
+    paths = [
+        os.path.join(root, "ops", "spmv.py"),
+        os.path.join(root, "core", "aggregation.py"),
+        os.path.join(root, "core", "stream.py"),
+    ]
+    pass_obj = [analysis.load_passes()["shapeflow"]]
+    findings = analysis.analyze_paths(paths, pass_obj, root=REPO_ROOT)
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    new, _old = analysis.apply_baseline(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+# ---------------------------------------------------------------------------
+# runtime cross-check: the static verdict matches compile_cache's meter
+
+
+def _load_corpus_module(name):
+    path = os.path.join(CORPUS, name + ".py")
+    spec = importlib.util.spec_from_file_location(
+        f"shapeflow_corpus_{name}", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def small_cache(monkeypatch):
+    """A 4-entry compile cache, emptied before and after: small enough
+    that the unbucketed repro's key churn forces evictions + re-traces
+    within a handful of calls."""
+    from gelly_streaming_tpu.core import compile_cache
+
+    monkeypatch.setattr(compile_cache, "_CAPACITY", 4)
+    compile_cache.clear()
+    yield compile_cache
+    compile_cache.clear()
+
+
+BATCHES = [[float(i + 1) for i in range(n)] for n in range(1, 9)]
+
+
+def test_unbucketed_repro_actually_recompiles(small_cache):
+    """The seeded UNBUCKETED is a real defect, not a style nit: 8 distinct
+    data-dependent keys cycled twice through a 4-entry cache evict and
+    re-trace the same (key, signature) — the retrace guard's meter moves."""
+    bad = _load_corpus_module("bad_shapeflow")
+    for _ in range(2):
+        for batch in BATCHES:
+            bad.unbucketed_step(batch)
+    stats = small_cache.stats()
+    assert stats["recompiles"] > 0, stats
+
+
+def test_bucketed_twin_stays_at_zero_recompiles(small_cache):
+    """The good twin's fix is sufficient, not just quieter: the SAME batch
+    sizes rounded through pow2_bucket collapse to <= 4 shape classes, fit
+    the 4-entry cache, and never re-trace."""
+    good = _load_corpus_module("good_shapeflow")
+    for _ in range(2):
+        for batch in BATCHES:
+            good.bucketed_step(batch)
+    stats = small_cache.stats()
+    assert stats["recompiles"] == 0, stats
+    assert stats["entries"] <= 4
