@@ -612,6 +612,166 @@ def _fused_dispatch_bench(windows: int = 64, win_edges: int = 256,
     return out
 
 
+def _sketch_bench(
+    windows: int = 16, win_edges: int = 1 << 12, capacity: int = 1 << 18
+):
+    """Sketch-summary tenancy quadrant (ISSUE 19): fixed-tiny-state
+    approximate descriptors vs their exact twins on one chip.
+
+    Three figures, all regression-gated:
+
+    * ``sketch_tenancy_ratio`` — jobs ADMITTED under the same
+      ``max_state_bytes`` cap, HLL degree-cardinality sketch vs the exact
+      degree summary at the same vertex capacity (the >= 10x headline:
+      sketch admission bytes are a function of (eps, delta), not of
+      ``vertex_capacity``, so the exact job's O(C) budget buys dozens of
+      sketch tenants).  Counted by real submits against a real
+      ``JobManager`` byte cap — jobs are gated unreleased so completions
+      can't free budget mid-count — not by arithmetic on declared sizes.
+    * ``sketch_triangle_rel_err`` — the neighborhood-sampling triangle
+      estimate vs the exact dense-adjacency count on a seeded
+      hub-clustered graph.  Seeded stream + salted hashing make the
+      estimate DETERMINISTIC per platform, so the gate pins a constant,
+      not a random draw.
+    * ``sketch_recompiles_after_warm`` — 1 -> 16 sketch-job tenancy drift
+      with fused dispatch on, after a single-job warmup: same-contract
+      tenants share ``cache_token`` and must retrace nothing.
+
+    Plus ``sketch_agg_eps_{1,16}`` (aggregate fold throughput of the
+    sketch tenancy with ``fused_dispatch=1``) for the eps ledger.
+    """
+    import threading
+
+    from gelly_streaming_tpu.core import compile_cache
+    from gelly_streaming_tpu.core.config import RuntimeConfig, StreamConfig
+    from gelly_streaming_tpu.core.stream import EdgeStream
+    from gelly_streaming_tpu.library.degree_distribution import (
+        DegreeDistributionSummary,
+    )
+    from gelly_streaming_tpu.library.sketches import (
+        HLLDegreeSummary,
+        SketchTriangleCount,
+    )
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.runtime.job import AdmissionError
+
+    out = {}
+    rng = np.random.default_rng(19)
+
+    # ---- tenancy under one byte cap: exact degree vs HLL degree sketch ----
+    tiny_n = win_edges  # one window per admission probe: admission is the
+    # contended resource here, not fold volume
+    cfg = StreamConfig(
+        vertex_capacity=capacity,
+        batch_size=win_edges // 2,
+        ingest_window_edges=win_edges,
+    )
+    tiny = (
+        rng.integers(0, capacity, tiny_n).astype(np.int32),
+        rng.integers(0, capacity, tiny_n).astype(np.int32),
+    )
+    exact_bytes = DegreeDistributionSummary().admission_nbytes(cfg)
+    cap_bytes = 2 * exact_bytes  # exactly two exact jobs fit
+
+    def admitted(make_desc, tag):
+        release = threading.Event()
+        count = 0
+        with JobManager(
+            RuntimeConfig(max_jobs=600, max_state_bytes=cap_bytes)
+        ) as manager:
+            for i in range(600):
+                try:
+                    manager.submit_aggregation(
+                        EdgeStream.from_arrays(*tiny, cfg),
+                        make_desc(),
+                        name=f"adm-{tag}-{i}",
+                        sink=lambda rec: None,
+                        ready=release.is_set,
+                    )
+                except AdmissionError:
+                    break
+                count += 1
+            release.set()
+            manager.poke()
+            manager.wait_all()
+        return count
+
+    n_exact = admitted(DegreeDistributionSummary, "exact")
+    n_sketch = admitted(HLLDegreeSummary, "hll")
+    out["sketch_exact_admitted"] = n_exact
+    out["sketch_admitted"] = n_sketch
+    out["sketch_tenancy_ratio"] = round(n_sketch / max(n_exact, 1), 2)
+
+    # ---- triangle estimate vs the exact count (seeded, deterministic) -----
+    tri_cap = 256
+    tri_n = 40 << 10
+    ts, td = _skewed_sample(np.random.default_rng(7), tri_n, tri_cap)
+    tri_cfg = StreamConfig(
+        vertex_capacity=tri_cap,
+        batch_size=1 << 12,
+        ingest_window_edges=tri_n,
+    )
+    tri = SketchTriangleCount(eps=0.05, delta=0.05)
+    est = None
+    for rec in EdgeStream.from_arrays(ts, td, tri_cfg).aggregate(tri):
+        est = float(np.asarray(rec[0]))
+    adj = np.zeros((tri_cap, tri_cap), dtype=np.int64)
+    keep = ts != td
+    adj[ts[keep], td[keep]] = 1
+    adj = np.maximum(adj, adj.T)
+    exact_tri = int(np.trace(adj @ adj @ adj)) // 6
+    out["sketch_triangle_exact"] = exact_tri
+    out["sketch_triangle_est"] = round(est, 1)
+    out["sketch_triangle_rel_err"] = round(
+        abs(est - exact_tri) / max(exact_tri, 1), 4
+    )
+
+    # ---- 1 -> 16 sketch tenancy, fused dispatch on, retrace guard ---------
+    n = windows * win_edges
+    fused_cfg = StreamConfig(
+        vertex_capacity=1 << 16,
+        # misaligned to the window cut: the wire fast path declines, the
+        # windowed plane runs, and fused cohorts get to form
+        batch_size=(win_edges // 2) + 32,
+        ingest_window_edges=win_edges,
+        fused_dispatch=1,
+    )
+    datasets = [
+        (
+            rng.integers(0, 1 << 16, n).astype(np.int32),
+            rng.integers(0, 1 << 16, n).astype(np.int32),
+        )
+        for _ in range(16)
+    ]
+
+    def run(n_jobs):
+        release = threading.Event()
+        with JobManager(
+            RuntimeConfig(max_jobs=16, fair_quantum=4)
+        ) as manager:
+            for i in range(n_jobs):
+                manager.submit_aggregation(
+                    EdgeStream.from_arrays(*datasets[i], fused_cfg),
+                    HLLDegreeSummary(),
+                    name=f"sk-{n_jobs}x-{i}",
+                    sink=lambda rec: np.asarray(rec[0]),
+                    ready=release.is_set,
+                )
+            t0 = time.perf_counter()
+            release.set()
+            manager.poke()
+            manager.wait_all()
+        return n_jobs * n / (time.perf_counter() - t0)
+
+    run(1)  # warmup: the sketch fold + transform executables land here
+    compile_cache.reset_stats()
+    out["sketch_agg_eps_1"] = round(run(1), 1)
+    out["sketch_agg_eps_16"] = round(run(16), 1)
+    out["sketch_recompiles_after_warm"] = compile_cache.stats()["recompiles"]
+    out["sketch_compiles_after_warm"] = compile_cache.stats()["compiles"]
+    return out
+
+
 def _spmv_bench(capacity: int = 1 << 15, num_edges: int = 1 << 18):
     """Masked-semiring SpMV kernel core (ISSUE 17): direction optimization
     on a skewed community graph.
@@ -1132,6 +1292,8 @@ _HIGHER_KEYS = {
     # ISSUE 16 fused-dispatch headlines: the job-count suffix evades the
     # `_eps` rule, and fairness/parity carry no classified suffix at all
     "fused_agg_eps_16",
+    # ISSUE 19 sketch tenancy: same job-count-suffix evasion
+    "sketch_agg_eps_16",
     "fairness_min_max_fused",
     "fused_parity_ok",
     # ISSUE 17 spmv kernel core: answer parity across directions carries
@@ -1147,7 +1309,16 @@ _HIGHER_SUFFIXES = (
     "_spread",
     "_util_lower_bound",
 )
-_LOWER_SUFFIXES = ("_ms", "_bytes_per_edge", "_spilled", "_findings")
+_LOWER_SUFFIXES = (
+    "_ms",
+    "_bytes_per_edge",
+    "_spilled",
+    "_findings",
+    # ISSUE 19 sketch accuracy: a relative-error figure regresses UPWARD
+    # (the seeded streams make it deterministic per platform, so the gate
+    # pins a constant, not a random draw)
+    "_rel_err",
+)
 _LOWER_SUBSTRINGS = ("recompiles", "_stall_s")
 
 
@@ -1961,6 +2132,35 @@ def main():
             )
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"multi-tenant bench skipped: {e}", file=sys.stderr)
+
+    # ---- sketch summaries: tenancy ratio, accuracy, retrace guard ----------
+    # (ISSUE 19 acceptance: >= 10x sketch-vs-exact admissions under one
+    # max_state_bytes cap, triangle estimate within its declared (eps,
+    # delta) on the seeded stream, 0 recompiles across 1 -> 16 tenancy)
+    sketch_stats = {}
+    try:
+        if os.environ.get("GELLY_BENCH_SKETCH", "1") != "0":
+            sketch_stats = _sketch_bench(
+                windows=int(os.environ.get("GELLY_BENCH_SKETCH_WINDOWS", 16)),
+                win_edges=int(
+                    os.environ.get("GELLY_BENCH_SKETCH_WIN_EDGES", 1 << 12)
+                ),
+            )
+            _PARTIAL.update(sketch_stats)
+            print(
+                f"sketch tenancy: {sketch_stats['sketch_admitted']} sketch "
+                f"vs {sketch_stats['sketch_exact_admitted']} exact jobs "
+                f"under one cap (x{sketch_stats['sketch_tenancy_ratio']}); "
+                f"triangles {sketch_stats['sketch_triangle_est']} vs exact "
+                f"{sketch_stats['sketch_triangle_exact']} (rel err "
+                f"{sketch_stats['sketch_triangle_rel_err']}); 1/16 jobs "
+                f"{sketch_stats['sketch_agg_eps_1'] / 1e6:.2f}/"
+                f"{sketch_stats['sketch_agg_eps_16'] / 1e6:.2f}M eps, "
+                f"recompiles {sketch_stats['sketch_recompiles_after_warm']}",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"sketch bench skipped: {e}", file=sys.stderr)
 
     # ---- streaming RPC serving plane: clients in {1, 4, 16} over loopback --
     # (ISSUE 8 acceptance: connection-scaling eps and p50/p99

@@ -110,20 +110,23 @@ def main(argv=None) -> int:
         nargs="+",
         default=[
             "core",
+            "examples",
             "io",
             "library",
             "native_src",
             "ops",
             "parallel",
             "runtime",
+            "summaries",
             "utils",
         ],
         help="files/directories to scan; bare names resolve inside the "
-        "gelly_streaming_tpu package (default: core io library "
-        "native_src ops parallel runtime utils — utils hosts the "
-        "tracing flight recorder and metrics registries whose lock "
+        "gelly_streaming_tpu package (default: core examples io library "
+        "native_src ops parallel runtime summaries utils — utils hosts "
+        "the tracing flight recorder and metrics registries whose lock "
         "discipline the lock pass pins, native_src the C++ byte path "
-        "the nativecheck passes lint)",
+        "the nativecheck passes lint, summaries the sketch kernel "
+        "module, examples the user-facing CLIs)",
     )
     parser.add_argument(
         "--select",

@@ -159,6 +159,39 @@ class SummaryAggregation:
             )
         )
 
+    def emission_scratch(self, cfg: StreamConfig):
+        """Pytree of ``jax.ShapeDtypeStruct`` leaves describing the transient
+        device buffers ``transform`` materializes at emission time beyond the
+        summary itself (e.g. a sketch's gathered register view, a top-k
+        heap, wedge-closure matrices).  Purely declarative — nothing is
+        allocated; the default (no scratch) is right for descriptors whose
+        transform is a view or O(1) reduction of the state.
+        """
+        return ()
+
+    def aux_emission_nbytes(self, cfg: StreamConfig) -> int:
+        """Bytes of ``emission_scratch`` — the emission-time residue that
+        ``state_nbytes`` alone does not see."""
+        return int(
+            sum(
+                int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree.leaves(self.emission_scratch(cfg))
+            )
+        )
+
+    def admission_nbytes(self, cfg: StreamConfig) -> int:
+        """What admission control must charge for one instance of this query:
+        the persistent summary PLUS the peak transient emission-time scratch.
+
+        ``state_nbytes`` assumed the summary IS the job's whole device state;
+        for sketch descriptors the emission-time buffers (top-k heap,
+        gathered register view, wedge matrices) can dominate the KB-scale
+        registers, so a thousand admitted jobs priced by registers alone
+        could OOM on the unpriced residue.  runtime/manager.py and
+        runtime/server.py charge THIS value against ``max_state_bytes``.
+        """
+        return self.state_nbytes(cfg) + self.aux_emission_nbytes(cfg)
+
     # -- execution ------------------------------------------------------------
 
     def _num_partitions(self, cfg: StreamConfig) -> int:

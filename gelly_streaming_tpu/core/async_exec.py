@@ -279,10 +279,12 @@ def async_merge_loop(
     drain point leaves the same snapshot/emission frontier as the sync path.
 
     ``release(payload)`` (optional) recycles a window's transfer arenas at
-    drain time; it is called only after the window's emission record is
-    known complete (``wait_ready``), whose data dependency on the fold
-    proves the arena's host memory is no longer read (donation-safe
-    ownership, see ArenaPool).
+    drain time; it is called only after the window's FOLD OUTPUT is known
+    complete (``wait_ready``), which proves the arena's host memory is no
+    longer read (donation-safe ownership, see ArenaPool).  The fold output
+    — not the emission record — is the wait target because transforms may
+    wrap state in non-pytree host objects whose leaves wait_ready cannot
+    block on.
     """
     running = None
     start_after = -1
@@ -331,13 +333,15 @@ def async_merge_loop(
 
     def drain_one():
         nonlocal drained_through, drained_global
-        wid, rec, summary, payload, span, t_item = pending.popleft()
+        wid, rec, summary, payload, span, t_item, fold_out = pending.popleft()
         metrics.pipeline_add("pipeline_windows_drained", 1)
         t_drain = time.perf_counter()
         if release is not None and payload is not None:
-            # the emission depends on this window's fold: its completion
-            # proves the fold consumed the arena's host memory
-            wait_ready(rec)
+            # wait on the FOLD OUTPUT, not the transformed record: transforms
+            # may return non-pytree host wrappers (e.g. CC's DisjointSet)
+            # whose opaque leaves wait_ready silently skips, which would
+            # recycle the arena under a still-pending zero-copy fold
+            wait_ready(fold_out)
             release(payload)  # arena-live-until: drain — this IS the drain
         t_emit = time.perf_counter()
         # emission latency for EVERY window (bounded histogram, one lock
@@ -398,6 +402,7 @@ def async_merge_loop(
                     payload if release is not None else None,
                     span,
                     t_item,
+                    pane_summary if release is not None else None,
                 )
             )
             metrics.pipeline_add("pipeline_windows_dispatched", 1)

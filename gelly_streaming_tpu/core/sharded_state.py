@@ -54,7 +54,9 @@ from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
 from gelly_streaming_tpu.utils.envswitch import resolve_switch
 
 
-def reshard_summary(blocks, cfg, old_num_shards: int, new_num_shards: int):
+def reshard_summary(
+    blocks, cfg, old_num_shards: int, new_num_shards: int, rows=None
+):
     """Re-route owner-sharded summary blocks into a new shard geometry.
 
     ``blocks`` is a spec's block pytree — every array leaf laid out
@@ -76,6 +78,14 @@ def reshard_summary(blocks, cfg, old_num_shards: int, new_num_shards: int):
 
     Pure host reindexing (no device, no collective): both geometries are
     modulo-sharded, so the move is two reshapes per leaf, O(C) bytes.
+
+    ``rows`` selects the per-leaf row count the layout is validated
+    against: ``None`` (default) requires every leaf to be vertex-keyed
+    (``cfg.vertex_capacity`` rows — the owner-block summaries this plane
+    grew up on); ``"auto"`` takes each leaf's own ``S * block_rows`` total
+    — the register-keyed sketch blocks, whose leaves have DIFFERENT pow2
+    row counts (sample rows vs HLL registers vs count-min cells) that all
+    reblock by the same modulo rule.
     """
     import numpy as np
 
@@ -84,7 +94,7 @@ def reshard_summary(blocks, cfg, old_num_shards: int, new_num_shards: int):
     for name, s in (("old", old_s), ("new", new_s)):
         if s <= 0:
             raise ValueError(f"{name} shard count must be positive, got {s}")
-        if cap % s:
+        if rows is None and cap % s:
             raise ValueError(
                 f"vertex_capacity ({cap}) must be divisible by the {name} "
                 f"shard count ({s}) for even re-sharding"
@@ -92,18 +102,29 @@ def reshard_summary(blocks, cfg, old_num_shards: int, new_num_shards: int):
 
     def leaf(a):
         a = np.asarray(a)
-        if a.ndim < 2 or a.shape[0] != old_s or a.shape[0] * a.shape[1] != cap:
+        if a.ndim < 2 or a.shape[0] != old_s:
+            raise ValueError(
+                f"block leaf shape {a.shape} does not match the "
+                f"[{old_s}, rows/{old_s}, ...] owner-block layout"
+            )
+        total = a.shape[0] * a.shape[1]
+        if rows is None and total != cap:
             raise ValueError(
                 f"block leaf shape {a.shape} does not match the "
                 f"[{old_s}, {cap // old_s}, ...] owner-block layout"
             )
+        if total % new_s:
+            raise ValueError(
+                f"leaf row count ({total}) must be divisible by the new "
+                f"shard count ({new_s}) for even re-sharding"
+            )
         # shard_summary inverse: full[g] = blocks[g % S, g // S]
         full = np.ascontiguousarray(np.swapaxes(a, 0, 1)).reshape(
-            (cap,) + a.shape[2:]
+            (total,) + a.shape[2:]
         )
         # and shard_summary forward at the new geometry
         reblocked = np.swapaxes(
-            full.reshape((cap // new_s, new_s) + a.shape[2:]), 0, 1
+            full.reshape((total // new_s, new_s) + a.shape[2:]), 0, 1
         )
         return np.ascontiguousarray(reblocked)
 

@@ -1,0 +1,428 @@
+"""Sketch summary descriptors: (eps, delta)-bounded state in KB, not O(C) MB.
+
+Three approximate summaries built on the order-free monoid kernels in
+summaries/sketches.py, each an ORDINARY ``SummaryAggregation`` — they ride
+every existing plane (windowed folds, wire streaming, the mesh runner, the
+owner-sharded state plane, positional checkpoints, cross-tenant fused
+dispatch) with zero new machinery:
+
+  * ``SketchTriangleCount`` — streaming triangle estimate from an R-row
+    min-hash edge sample + distinct-edge HLL (the order-free form of
+    neighborhood sampling, arXiv:1308.2166).  Degrades to EXACT when the
+    sample covers every distinct edge.
+  * ``HLLDegreeSummary`` — distinct-vertex / distinct-edge cardinalities
+    from two HLL register banks (max-merge).
+  * ``CountMinHeavyHitters`` — top-k degree heavy hitters from a d x w
+    count-min grid (add-merge), the heap materialized only at emission.
+
+Every descriptor declares its ``(eps, delta)`` contract
+(``error_contract()``: surfaced in server ``status`` and the metrics sketch
+registry) and prices BOTH its persistent registers (``state_nbytes``) and
+its transient emission-time scratch (``emission_scratch`` — top-k heap,
+gathered register view, wedge matrices) so ``admission_nbytes`` is what a
+thousand admitted sketch jobs actually cost.
+
+All register shapes are pure functions of (eps, delta) through pow2 clamps,
+so ``cache_token`` — (class, shape params) — makes same-contract tenants
+share compiled executables and form perfect same-shape fused-dispatch
+cohorts: 0 recompiles across sketch-width and tenancy drift.
+
+Sharding: ``SketchShardedState`` block-shards every 1-D register leaf
+modulo-S (the same ``reshape(-1, S).T`` owner layout as the vertex-keyed
+specs) and reconciles with ONE dense slab all_to_all + the descriptor's own
+commutative combine — registers are KB, so dense slabs beat packed deltas
+at any realistic S, and merge commutativity makes sharded-vs-solo folds
+bit-identical.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_streaming_tpu.core.aggregation import SummaryBulkAggregation
+from gelly_streaming_tpu.core.config import StreamConfig
+from gelly_streaming_tpu.core.sharded_state import (
+    ExchangeStats,
+    ShardedStateSpec,
+)
+from gelly_streaming_tpu.summaries import sketches as sk
+
+#: the serving-plane catalog of sketch summary kinds
+SKETCH_KINDS = ("sketch_triangles", "hll_degree", "cm_heavy_hitters")
+
+
+class SketchParamError(ValueError):
+    """Invalid (eps, delta) contract — raised at descriptor CONSTRUCTION so
+    admission (gelly-serve / gelly-client submit / JobManager) refuses
+    loudly with a typed error instead of folding garbage or hanging."""
+
+
+def _check_eps_delta(eps: float, delta: float) -> tuple:
+    try:
+        eps = float(eps)
+        delta = float(delta)
+    except (TypeError, ValueError):
+        raise SketchParamError(
+            f"eps/delta must be numbers, got eps={eps!r} delta={delta!r}"
+        )
+    if not (0.0 < eps < 1.0):
+        raise SketchParamError(f"eps must be in (0, 1), got {eps}")
+    if not (0.0 < delta < 1.0):
+        raise SketchParamError(f"delta must be in (0, 1), got {delta}")
+    return eps, delta
+
+
+class SketchShardedState(ShardedStateSpec):
+    """Generic owner-sharded plane for 1-D pow2 register pytrees.
+
+    Every sketch state leaf is 1-D with pow2 length, so each leaf
+    block-shards modulo-S exactly like the vertex-keyed summaries (row g of
+    a leaf lives on shard g % S at block row g // S) — which keeps
+    row-coupled leaves (the min-hash sample's (hash, lo, hi) columns)
+    co-resident, and keeps ``reshard_summary(..., rows="auto")`` a pure
+    host reindex.  Reconciliation is ONE dense slab all_to_all per leaf
+    plus an S-way fold of the descriptor's own commutative ``combine``:
+    registers are KB-sized, so a dense slab costs less than the packed
+    (row, value) delta machinery at any realistic S, and there is nothing
+    to spill or retry — the exchange is exactly one round, always.
+    """
+
+    route_key = None  # registers are hash-addressed: no owner to route by
+
+    def _leaf_sizes(self, cfg) -> list:
+        return [
+            (int(np.prod(leaf.shape)), np.dtype(leaf.dtype).itemsize)
+            for leaf in jax.tree.leaves(
+                jax.eval_shape(lambda: self.agg.initial_state(cfg))
+            )
+        ]
+
+    def initial_shard_state(self, cfg, num_shards: int):
+        return self.shard_summary(
+            jax.tree.map(np.asarray, self.agg.initial_state(cfg)),
+            cfg,
+            num_shards,
+        )
+
+    def shard_summary(self, summary, cfg, num_shards: int):
+        def block(a):
+            a = np.asarray(a)
+            if a.size % num_shards:
+                raise ValueError(
+                    f"sketch leaf of {a.size} rows cannot shard evenly "
+                    f"over {num_shards} shards"
+                )
+            return np.ascontiguousarray(a.reshape(-1, num_shards).T)
+
+        return jax.tree.map(block, summary)
+
+    def delta_bound(self, cfg, n_edges: int) -> int:
+        return 1  # dense slabs only: the delta buffers are never used
+
+    def comm_profile(self, cfg, ctx) -> dict:
+        from gelly_streaming_tpu.parallel import routing
+
+        round_nbytes = sum(
+            routing.slab_exchange_nbytes(size, itemsize)
+            for size, itemsize in self._leaf_sizes(cfg)
+        )
+        gather_nbytes = sum(
+            routing.gather_blocks_nbytes(size, itemsize)
+            for size, itemsize in self._leaf_sizes(cfg)
+        )
+        return {"round_nbytes": round_nbytes, "gather_nbytes": gather_nbytes}
+
+    def exchange(self, local_state, blocks, ctx):
+        from gelly_streaming_tpu.parallel import routing
+
+        n, axis = ctx.num_shards, ctx.axis_name
+        # recv[leaf][s] = what peer s folded for the rows THIS shard owns
+        recv = jax.tree.map(
+            lambda a: routing.slab_exchange(a, n, axis), local_state
+        )
+        merged = blocks
+        for s in range(n):
+            merged = self.agg.combine(
+                merged, jax.tree.map(lambda a: a[s], recv)
+            )
+        rows = max(size // n for size, _ in self._leaf_sizes(ctx.cfg))
+        one = jnp.ones((), jnp.int32)
+        return merged, ExchangeStats(
+            rounds=one,
+            delta_hwm=jnp.full((), rows, jnp.int32),
+            spilled=one * 0,
+        )
+
+    def gather_state(self, blocks, ctx):
+        from gelly_streaming_tpu.parallel import routing
+
+        return jax.tree.map(
+            lambda a: routing.gather_blocks(a, ctx.num_shards, ctx.axis_name),  # gather-ok: emit — registers reassemble lazily at emission/snapshot boundaries
+            blocks,
+        )
+
+
+class _SketchSummary(SummaryBulkAggregation):
+    """Shared sketch-descriptor surface: contract, pricing, sharding."""
+
+    #: serving-plane kind string (SKETCH_KINDS); subclasses set it
+    kind: str = ""
+    # register folds commute: legal on the sorted EF40 multiset wire
+    # encoding, and the precondition for the sharded/fused planes
+    order_free = True
+
+    def __init__(self, eps: float, delta: float, window_ms=None):
+        super().__init__(window_ms)
+        self.eps, self.delta = _check_eps_delta(eps, delta)
+
+    def error_contract(self) -> dict:
+        """The declared (eps, delta) bound, as surfaced in server status
+        lines and the utils.metrics sketch registry."""
+        return {"kind": self.kind, "eps": self.eps, "delta": self.delta}
+
+    def sharded_state_spec(self, cfg: StreamConfig):
+        return SketchShardedState(self)
+
+
+class TriangleSketchState(NamedTuple):
+    eh: jax.Array  # uint32[R]  per-bucket min sample-hash (EMPTY_HASH = none)
+    elo: jax.Array  # int32[R]  sampled edge lo endpoint (-1 = none)
+    ehi: jax.Array  # int32[R]  sampled edge hi endpoint (-1 = none)
+    regs: jax.Array  # int32[M]  distinct-edge HLL registers
+
+
+class SketchTriangleCount(_SketchSummary):
+    """Streaming triangle estimate from R min-hash-sampled edges.
+
+    Emits ``(estimate, sampled_rows, distinct_edges)`` per window.  The
+    estimate scales the closed wedges found WITHIN the sample by the cube
+    of the per-edge inclusion probability (occupied rows / distinct edges,
+    the latter from the composed HLL bank) — see
+    ``summaries.sketches.tri_estimate``.  When the stream's distinct edges
+    fit the sample (p = 1) the estimate IS the exact count; the declared
+    (eps, delta) otherwise assumes enough triangle mass for concentration
+    (the regime the seeded zipf equivalence tests pin).
+    """
+
+    kind = "sketch_triangles"
+
+    def __init__(self, eps=0.1, delta=0.05, window_ms=None):
+        super().__init__(eps, delta, window_ms)
+        self.rows = sk.tri_rows(self.eps, self.delta)
+        self.hll_m = sk.hll_num_registers(max(self.eps / 2.0, 0.01))
+
+    @property
+    def cache_token(self):
+        # pure function of (class, register shapes): same-contract tenants
+        # share executables and fuse into one same-shape cohort
+        return (type(self), self.rows, self.hll_m)
+
+    def initial_state(self, cfg: StreamConfig) -> TriangleSketchState:
+        eh, elo, ehi = sk.tri_init(self.rows)
+        return TriangleSketchState(
+            eh=eh, elo=elo, ehi=ehi, regs=sk.hll_init(self.hll_m)
+        )
+
+    def update(self, state, src, dst, val, mask) -> TriangleSketchState:
+        eh, elo, ehi = sk.tri_fold(
+            (state.eh, state.elo, state.ehi), src, dst, mask
+        )
+        lo, hi = sk.canonical_edge(src, dst)
+        regs = sk.hll_fold(
+            state.regs,
+            sk.hash_pair_u32(lo, hi, sk.SALT_EDGE_HLL),
+            mask & (lo != hi),
+        )
+        return TriangleSketchState(eh=eh, elo=elo, ehi=ehi, regs=regs)
+
+    def combine(self, a, b) -> TriangleSketchState:
+        eh, elo, ehi = sk.tri_merge(
+            (a.eh, a.elo, a.ehi), (b.eh, b.elo, b.ehi)
+        )
+        return TriangleSketchState(
+            eh=eh, elo=elo, ehi=ehi, regs=sk.hll_merge(a.regs, b.regs)
+        )
+
+    def transform(self, state):
+        return sk.tri_estimate(
+            (state.eh, state.elo, state.ehi), state.regs
+        )
+
+    def emission_scratch(self, cfg: StreamConfig):
+        # the closure check's peak live set: one [BLOCK, R] wedge strip
+        # (closing endpoints + membership keys, ~4 int32-equivalents live
+        # at once) plus the sorted membership keys
+        r = self.rows
+        b = min(sk.TRI_CLOSURE_BLOCK, r)
+        return (
+            jax.ShapeDtypeStruct((b, r), jnp.int32),
+            jax.ShapeDtypeStruct((b, r), jnp.int32),
+            jax.ShapeDtypeStruct((b, r), jnp.int32),
+            jax.ShapeDtypeStruct((b, r), jnp.uint32),
+            jax.ShapeDtypeStruct((r,), jnp.uint32),
+        )
+
+
+class HLLDegreeState(NamedTuple):
+    verts: jax.Array  # int32[M] distinct-vertex registers
+    edges: jax.Array  # int32[M] distinct-edge registers
+
+
+class HLLDegreeSummary(_SketchSummary):
+    """Distinct-vertex / distinct-edge cardinalities (max-merge registers).
+
+    Emits ``(distinct_vertices, distinct_edges)`` float32 estimates per
+    window — the degree-cardinality view (how many vertices are live, how
+    many distinct undirected edges touched them) at 2m registers instead of
+    the exact summaries' O(C) rows.
+    """
+
+    kind = "hll_degree"
+
+    def __init__(self, eps=0.05, delta=0.05, window_ms=None):
+        super().__init__(eps, delta, window_ms)
+        self.hll_m = sk.hll_num_registers(self.eps)
+
+    @property
+    def cache_token(self):
+        return (type(self), self.hll_m)
+
+    def initial_state(self, cfg: StreamConfig) -> HLLDegreeState:
+        return HLLDegreeState(
+            verts=sk.hll_init(self.hll_m), edges=sk.hll_init(self.hll_m)
+        )
+
+    def update(self, state, src, dst, val, mask) -> HLLDegreeState:
+        verts = sk.hll_fold(
+            state.verts, sk.hash_u32(src, sk.SALT_VERTEX_HLL), mask
+        )
+        verts = sk.hll_fold(
+            verts, sk.hash_u32(dst, sk.SALT_VERTEX_HLL), mask
+        )
+        lo, hi = sk.canonical_edge(src, dst)
+        edges = sk.hll_fold(
+            state.edges, sk.hash_pair_u32(lo, hi, sk.SALT_EDGE_HLL), mask
+        )
+        return HLLDegreeState(verts=verts, edges=edges)
+
+    def combine(self, a, b) -> HLLDegreeState:
+        return HLLDegreeState(
+            verts=sk.hll_merge(a.verts, b.verts),
+            edges=sk.hll_merge(a.edges, b.edges),
+        )
+
+    def transform(self, state):
+        return sk.hll_estimate(state.verts), sk.hll_estimate(state.edges)
+
+    def emission_scratch(self, cfg: StreamConfig):
+        # the sharded plane's gathered register view (transient full-[m]
+        # reassembly of both banks at emission)
+        m = self.hll_m
+        return (
+            jax.ShapeDtypeStruct((m,), jnp.int32),
+            jax.ShapeDtypeStruct((m,), jnp.int32),
+        )
+
+
+class CountMinState(NamedTuple):
+    grid: jax.Array  # int32[d * w] counter grid, stored flat
+
+
+class CountMinHeavyHitters(_SketchSummary):
+    """Top-k degree heavy hitters from a count-min grid (add-merge).
+
+    Each edge increments both endpoints' degree counters in all d rows;
+    ``transform`` materializes the per-vertex estimate view (min over rows,
+    for every vertex id < capacity) and takes the top-k — the "heap" lives
+    ONLY at emission time, which is exactly why ``emission_scratch`` must
+    price the O(C) gathered view: the persistent grid is KB, the residue is
+    not.  Emits ``(vertex_ids[k], degree_estimates[k])``.
+    """
+
+    kind = "cm_heavy_hitters"
+
+    def __init__(self, eps=0.01, delta=0.02, top_k=16, window_ms=None):
+        super().__init__(eps, delta, window_ms)
+        self.top_k = int(top_k)
+        if self.top_k <= 0:
+            raise SketchParamError(
+                f"top_k must be positive, got {self.top_k}"
+            )
+        self.depth, self.width = sk.cm_dims(self.eps, self.delta)
+        # transform needs the candidate-id range; bound at initial_state
+        # (always called before any fold/transform on every plane)
+        self._capacity = None
+
+    @property
+    def cache_token(self):
+        return (type(self), self.depth, self.width, self.top_k)
+
+    def error_contract(self) -> dict:
+        out = super().error_contract()
+        out["top_k"] = self.top_k
+        return out
+
+    def initial_state(self, cfg: StreamConfig) -> CountMinState:
+        self._capacity = cfg.vertex_capacity
+        return CountMinState(grid=sk.cm_init(self.depth, self.width))
+
+    def update(self, state, src, dst, val, mask) -> CountMinState:
+        ones = jnp.ones(src.shape, jnp.int32)
+        grid = sk.cm_fold(
+            state.grid, self.depth, self.width, src, ones, mask
+        )
+        grid = sk.cm_fold(grid, self.depth, self.width, dst, ones, mask)
+        return CountMinState(grid=grid)
+
+    def combine(self, a, b) -> CountMinState:
+        return CountMinState(grid=sk.cm_merge(a.grid, b.grid))
+
+    def transform(self, state):
+        if self._capacity is None:
+            raise RuntimeError(
+                "CountMinHeavyHitters.transform before initial_state: "
+                "the candidate-id range is bound per StreamConfig"
+            )
+        ids = jnp.arange(self._capacity, dtype=jnp.int32)
+        est = sk.cm_query(state.grid, self.depth, self.width, ids)
+        vals, idx = jax.lax.top_k(est, min(self.top_k, self._capacity))
+        return idx.astype(jnp.int32), vals
+
+    def emission_scratch(self, cfg: StreamConfig):
+        # the O(C) gathered estimate view the top-k scans — THE residue
+        # that dwarfs the persistent grid and must be admission-priced
+        return (
+            jax.ShapeDtypeStruct((cfg.vertex_capacity,), jnp.int32),
+            jax.ShapeDtypeStruct((self.top_k,), jnp.int32),
+            jax.ShapeDtypeStruct((self.top_k,), jnp.int32),
+        )
+
+
+def make_sketch(kind: str, eps=None, delta=None, top_k=None, window_ms=None):
+    """Serving-plane factory: a sketch descriptor from its catalog kind.
+
+    Unknown kinds and malformed knobs raise ``SketchParamError`` — the
+    typed refusal gelly-serve/gelly-client admission converts to a loud
+    ``bad-spec`` error (never a hang, never a silently-exact fallback).
+    """
+    if kind not in SKETCH_KINDS:
+        raise SketchParamError(
+            f"unknown sketch kind {kind!r} (expected one of "
+            f"{'/'.join(SKETCH_KINDS)})"
+        )
+    kwargs = {"window_ms": window_ms}
+    if eps is not None:
+        kwargs["eps"] = eps
+    if delta is not None:
+        kwargs["delta"] = delta
+    if kind == "sketch_triangles":
+        return SketchTriangleCount(**kwargs)
+    if kind == "hll_degree":
+        return HLLDegreeSummary(**kwargs)
+    if top_k is not None:
+        kwargs["top_k"] = top_k
+    return CountMinHeavyHitters(**kwargs)

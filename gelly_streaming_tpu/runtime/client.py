@@ -391,6 +391,25 @@ def main(argv=None) -> int:
     p_submit.add_argument(
         "--query", default="cc", choices=("cc", "degree", "edges")
     )
+    p_submit.add_argument(
+        "--summary",
+        default=None,
+        choices=("sketch_triangles", "hll_degree", "cm_heavy_hitters"),
+        help="swap the job's summary for a fixed-tiny-state sketch "
+        "(overrides --query; see --eps/--delta)",
+    )
+    p_submit.add_argument(
+        "--eps",
+        type=float,
+        default=None,
+        help="sketch relative-error target (sketch summaries only)",
+    )
+    p_submit.add_argument(
+        "--delta",
+        type=float,
+        default=None,
+        help="sketch failure probability of the eps bound",
+    )
     p_submit.add_argument("--capacity", type=int, default=1 << 16)
     p_submit.add_argument("--window-edges", type=int, default=1 << 13)
     p_submit.add_argument("--batch", type=int, default=1 << 12)
@@ -480,7 +499,7 @@ def _run_cmd(client: GellyClient, args) -> int:
         )
         return 0
     if args.cmd == "submit":
-        reply = client.submit(
+        spec = dict(
             name=args.name,
             query=args.query,
             capacity=args.capacity,
@@ -489,11 +508,27 @@ def _run_cmd(client: GellyClient, args) -> int:
             weight=args.weight,
             checkpoint=args.checkpoint,
         )
-        print(
+        # sketch knobs travel only when given: the server validates them
+        # at admission and refuses loudly on a bad contract
+        if args.summary is not None:
+            spec["summary"] = args.summary
+        if args.eps is not None:
+            spec["eps"] = args.eps
+        if args.delta is not None:
+            spec["delta"] = args.delta
+        reply = client.submit(**spec)
+        line = (
             f"submitted {reply['job']}: batch={reply['batch']} "
             f"window={reply['window_edges']} resume_edges="
             f"{reply['resume_edges']} accept_bdv={reply['accept_bdv']}"
         )
+        contract = reply.get("error_contract")
+        if contract:
+            line += (
+                f" sketch={contract['kind']} eps={contract['eps']} "
+                f"delta={contract['delta']}"
+            )
+        print(line)
         return 0
     if args.cmd == "push-edges":
         rng = np.random.default_rng(args.seed)

@@ -311,7 +311,11 @@ class JobManager:
         head start (how the fairness bench isolates scheduling from
         submission stagger).
 
-        State bytes come from ``descriptor.state_nbytes(stream.cfg)``;
+        State bytes come from ``descriptor.admission_nbytes(stream.cfg)``
+        — the persistent summary PLUS the declared emission-time scratch
+        (``emission_scratch``: a sketch's top-k heap, gathered register
+        view, wedge strips).  Pricing the summary alone would let a
+        thousand KB-state sketch jobs OOM on the unpriced residue;
         per-record edge accounting from the stream's ingestion-pane size
         when the source pins one (each emission covers one closed pane);
         the total-edge progress hint from ``stream.num_edges_hint()``.
@@ -326,7 +330,7 @@ class JobManager:
         from gelly_streaming_tpu.core import aggregation
 
         cfg = stream.cfg
-        state_bytes = descriptor.state_nbytes(cfg)
+        state_bytes = descriptor.admission_nbytes(cfg)
         edges_per_record = cfg.ingest_window_edges or 0
         eligible = getattr(descriptor, "fused_eligible", None)
         if (

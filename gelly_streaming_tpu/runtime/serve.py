@@ -20,9 +20,12 @@ Config file shape (every field optional; flags fill a synthetic default)::
     }
 
 Queries: ``cc`` (streaming connected components), ``degree`` (degree
-distribution summary), ``edges`` (running edge count).  Sources are
-synthetic uniform random graphs (seeded per job), streamed over the wire
-fast path with running per-window emission.
+distribution summary), ``edges`` (running edge count), plus the
+fixed-tiny-state sketch summaries ``sketch_triangles`` / ``hll_degree`` /
+``cm_heavy_hitters`` (``eps``/``delta`` knobs per job, or a ``summary``
+field that swaps the sketch into any spec).  Sources are synthetic
+uniform random graphs (seeded per job), streamed over the wire fast path
+with running per-window emission.
 """
 
 from __future__ import annotations
@@ -54,6 +57,11 @@ def _build_query(spec: dict):
     from gelly_streaming_tpu.runtime import server as server_mod
 
     query = spec.get("query", "cc")
+    # "summary" swaps in a fixed-tiny-state sketch descriptor by kind,
+    # keeping the rest of the spec unchanged — same override rule as the
+    # server's submit verb
+    if spec.get("summary") is not None:
+        query = spec["summary"]
     n = int(spec.get("edges", 100_000))
     capacity = int(spec.get("capacity", 1 << 16))
     window_edges = int(spec.get("window_edges", 1 << 13))
@@ -73,7 +81,7 @@ def _build_query(spec: dict):
     )
     stream = EdgeStream.from_arrays(src, dst, cfg)
     try:
-        return stream, server_mod.descriptor_for(query)
+        return stream, server_mod.descriptor_for(query, spec)
     except server_mod._Refused as e:
         raise SystemExit(str(e))
 
@@ -137,8 +145,29 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--query",
         default="cc",
-        choices=("cc", "degree", "edges"),
-        help="synthetic jobs' query",
+        choices=(
+            "cc",
+            "degree",
+            "edges",
+            "sketch_triangles",
+            "hll_degree",
+            "cm_heavy_hitters",
+        ),
+        help="synthetic jobs' query (sketch_* / hll_* / cm_* kinds are "
+        "the fixed-tiny-state approximate summaries)",
+    )
+    parser.add_argument(
+        "--eps",
+        type=float,
+        default=None,
+        help="sketch accuracy knob: relative-error target (sketch "
+        "queries only; each kind has a calibrated default)",
+    )
+    parser.add_argument(
+        "--delta",
+        type=float,
+        default=None,
+        help="sketch accuracy knob: failure probability of the eps bound",
     )
     parser.add_argument("--edges", type=int, default=100_000)
     parser.add_argument("--capacity", type=int, default=1 << 16)
@@ -167,6 +196,14 @@ def main(argv=None) -> int:
                     "capacity": args.capacity,
                     "window_edges": args.window_edges,
                     "seed": i,
+                    **(
+                        {"eps": args.eps} if args.eps is not None else {}
+                    ),
+                    **(
+                        {"delta": args.delta}
+                        if args.delta is not None
+                        else {}
+                    ),
                 }
                 for i in range(args.jobs)
             ]

@@ -116,9 +116,17 @@ def _edge_count_descriptor():
     return _EDGE_COUNT_CLS()
 
 
-def descriptor_for(query: str):
+def descriptor_for(query: str, spec: Optional[dict] = None):
     """The serving plane's query catalog (shared with ``gelly-serve``'s
-    synthetic driver): ``cc`` / ``degree`` / ``edges``."""
+    synthetic driver): the exact summaries ``cc`` / ``degree`` / ``edges``
+    plus the fixed-tiny-state sketch family (``sketch_triangles`` /
+    ``hll_degree`` / ``cm_heavy_hitters``).
+
+    Sketch kinds read their accuracy knobs — ``eps`` / ``delta`` (and
+    ``top_k`` for the heavy-hitter sketch) — from ``spec``; malformed
+    knobs surface as a typed ``bad-spec`` refusal AT ADMISSION (library
+    validation raises ``SketchParamError`` before any state is sized), so
+    a bad contract can never hang a submit or fall back to exact."""
     if query == "cc":
         from gelly_streaming_tpu.library.connected_components import (
             ConnectedComponents,
@@ -133,8 +141,28 @@ def descriptor_for(query: str):
         return DegreeDistributionSummary()
     if query == "edges":
         return _edge_count_descriptor()
+    from gelly_streaming_tpu.library import sketches
+
+    if query in sketches.SKETCH_KINDS:
+        spec = spec or {}
+        knobs = {}
+        try:
+            if spec.get("eps") is not None:
+                knobs["eps"] = float(spec["eps"])
+            if spec.get("delta") is not None:
+                knobs["delta"] = float(spec["delta"])
+            if spec.get("top_k") is not None:
+                knobs["top_k"] = int(spec["top_k"])
+        except (TypeError, ValueError) as e:
+            raise _Refused("bad-spec", f"bad sketch knob: {e}")
+        try:
+            return sketches.make_sketch(query, **knobs)
+        except sketches.SketchParamError as e:
+            raise _Refused("bad-spec", str(e))
     raise _Refused(
-        "bad-spec", f"unknown query {query!r} (expected cc/degree/edges)"
+        "bad-spec",
+        f"unknown query {query!r} (expected cc/degree/edges or a sketch "
+        f"kind: {'/'.join(sketches.SKETCH_KINDS)})",
     )
 
 
@@ -706,6 +734,16 @@ class StreamServer:
             raise _Refused("bad-spec", "job spec needs a non-empty 'name'")
         key = self._job_key(tenant, name)
         query = spec.get("query", "cc")
+        # ``summary`` selects a sketch descriptor by kind — it overrides
+        # ``query`` so a spec can keep its exact query name while swapping
+        # the summary for the fixed-tiny-state approximate one
+        summary_kind = spec.get("summary")
+        if summary_kind is not None:
+            if not isinstance(summary_kind, str):
+                raise _Refused(
+                    "bad-spec", "'summary' must be a sketch-kind string"
+                )
+            query = summary_kind
         weight = int(spec.get("weight", 1))
         if weight <= 0:
             raise _Refused("bad-spec", "job weight must be positive")
@@ -740,7 +778,7 @@ class StreamServer:
                 )
             except (TypeError, ValueError) as e:
                 raise _Refused("bad-spec", f"bad stream config: {e}")
-            descriptor = descriptor_for(query)
+            descriptor = descriptor_for(query, spec)
             stream = None
         elif source_kind == "generate":
             from gelly_streaming_tpu.runtime.serve import _build_query
@@ -765,7 +803,16 @@ class StreamServer:
                 "bad-spec", f"unknown source {source_kind!r} (push/generate)"
             )
 
-        state_bytes = descriptor.state_nbytes(cfg)
+        # admission charges the persistent summary PLUS the descriptor's
+        # declared emission-time scratch (top-k heaps, gathered register
+        # views): a job that fits its steady-state budget but OOMs at its
+        # first emit was never actually admissible
+        state_bytes = descriptor.admission_nbytes(cfg)
+        contract = (
+            descriptor.error_contract()
+            if hasattr(descriptor, "error_contract")
+            else None
+        )
 
         resume_edges = 0
         w = cfg.ingest_window_edges
@@ -832,6 +879,15 @@ class StreamServer:
             # preconditions a cursor-exact rescale needs)
             scaler.register(key, _ServedRescaleTarget(self, sj))
         metrics.tenant_add(tenant.tenant, "tenant_jobs_submitted", 1)
+        if contract is not None:
+            metrics.sketch_register(
+                key,
+                contract["kind"],
+                contract["eps"],
+                contract["delta"],
+                descriptor.state_nbytes(cfg),
+                state_bytes,
+            )
         if resume_edges:
             # the journal's restart-cursor record: a resumed job's replay
             # region is part of the post-mortem story (which edges were
@@ -854,6 +910,10 @@ class StreamServer:
                 "state_bytes": state_bytes,
                 "weight": weight * tenant.weight,
                 "checkpoint": bool(checkpoint_path),
+                # the declared accuracy contract of an approximate summary
+                # (None for the exact catalog): clients see WHAT accuracy
+                # they were admitted at, not just that they were admitted
+                "error_contract": contract,
             },
             b"",
             False,
@@ -1211,6 +1271,14 @@ class StreamServer:
         reply = {
             "ok": True,
             "status": status,
+            # this tenant's approximate-summary contracts: which jobs are
+            # sketches, at what declared (eps, delta), and the byte price
+            # each was admitted at (same disclosure scoping as the rows)
+            "sketch_jobs": {
+                k: v
+                for k, v in metrics.all_sketch_stats().items()
+                if k.startswith(prefix)
+            },
             "tenants": {tenant.tenant: metrics.tenant_stats(tenant.tenant)},
             "server": {
                 "connections": n_conns,
@@ -1273,6 +1341,11 @@ class StreamServer:
         snap["scale"] = {
             k: v
             for k, v in snap.get("scale", {}).items()
+            if k.startswith(prefix)
+        }
+        snap["sketch_jobs"] = {
+            k: v
+            for k, v in snap.get("sketch_jobs", {}).items()
             if k.startswith(prefix)
         }
         snap["alerts"] = [

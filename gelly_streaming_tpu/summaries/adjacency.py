@@ -163,9 +163,26 @@ def bounded_bfs(
     return reached[trg]
 
 
-# Compiled once per shape; the host wrappers are called per edge.
-_add_edge_j = jax.jit(add_undirected_edge)
-_bounded_bfs_j = jax.jit(bounded_bfs, static_argnames="k")
+def _add_edge_j(nbrs, deg, u, v):
+    """Per-shape executable via the process-global cache: recompiles stay
+    visible to the retrace guard and same-shape graphs share one kernel."""
+    from gelly_streaming_tpu.core.compile_cache import cached_jit
+
+    return cached_jit(("adjacency", "add_edge"), lambda: add_undirected_edge)(
+        nbrs, deg, u, v
+    )
+
+
+def _bounded_bfs_j(nbrs, src, trg, k: int):
+    from functools import partial
+
+    from gelly_streaming_tpu.core.compile_cache import cached_jit
+
+    # k is a trace constant (loop bound), so it keys the cache entry
+    return cached_jit(
+        ("adjacency", "bounded_bfs", int(k)),
+        lambda: partial(bounded_bfs, k=int(k)),
+    )(nbrs, src, trg)
 
 
 class AdjacencyListGraph:

@@ -21,9 +21,13 @@ from gelly_streaming_tpu.ops import unionfind as uf
 
 # Compiled once per shape: the host wrappers below are called per edge in tests
 # and per batch in pipelines; eager dispatch of the lax loops is prohibitive.
-_union_edges_seen_j = jax.jit(uf.union_edges_with_seen)
-_merge_parents_j = jax.jit(uf.merge_parents)
-_compress_j = jax.jit(uf.compress)
+# Deliberately raw jax.jit: these executables back ConnectedComponents' fold
+# chain, and the process-global LRU may evict them mid-stream under multi-job
+# cache churn, which reorders async-plane dispatch against in-flight panes.
+# Module-level jits pin them for the process lifetime instead.
+_union_edges_seen_j = jax.jit(uf.union_edges_with_seen)  # graft: disable=RAWJIT — pinned for process lifetime, see above
+_merge_parents_j = jax.jit(uf.merge_parents)  # graft: disable=RAWJIT — pinned for process lifetime, see above
+_compress_j = jax.jit(uf.compress)  # graft: disable=RAWJIT — pinned for process lifetime, see above
 
 
 class DisjointSet:

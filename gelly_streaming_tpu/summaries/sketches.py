@@ -1,0 +1,390 @@
+"""Fixed-tiny-state sketch kernel cores: min-hash edge sampling, HLL, count-min.
+
+The framework never materializes the graph — it keeps *summaries* in stateful
+operators, and the paper's own approximate examples (incidence sampling,
+spanners) trade exactness for bounded state.  This module is the kernel layer
+of that trade taken to its serving-plane conclusion (PAPERS.md, "Parallel
+Triangle Counting in Massive Streaming Graphs", arXiv:1308.2166): three
+sketches whose state is KB instead of the exact summaries' O(C) MB, so
+admission control can pack an order of magnitude more tenants per chip.
+
+Every kernel here is an ORDER-FREE COMMUTATIVE MONOID over its register
+array — the property the whole runtime leans on:
+
+  * min-hash edge sample — per-bucket lexicographic min on
+    ``(sample_hash, lo, hi)``; identity is the empty row.  The classic
+    neighborhood-sampling estimator keeps R reservoir rows via a sequential
+    1/i coin (arXiv:1308.2166 §3); the min-hash reformulation keeps the SAME
+    R-row uniform sample but makes it a deterministic function of the edge
+    SET, so folds commute, duplicates are idempotent, and sharded-vs-solo
+    merges are bit-identical.
+  * HLL registers — elementwise max of rank-of-leading-zero registers.
+  * count-min grid — elementwise add of a d x w counter grid (stored flat).
+
+All shapes are pow2-sized (``next_pow2`` clamps), so every sketch of a given
+(eps, delta) is the same shape: the compile cache sees one signature per
+width (0-recompile across tenancy drift) and the cross-tenant fused
+dispatcher sees perfect same-shape cohorts.
+
+Hashing is a salted murmur3 fmix32 finalizer — stateless and deterministic,
+which is what makes "the sample is a function of the set" true.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# golden-ratio odd constant: distinct salts decorrelate the hash families
+GOLDEN = np.uint32(0x9E3779B9)
+#: identity of the min-hash lattice — an empty sample row
+EMPTY_HASH = np.uint32(0xFFFFFFFF)
+#: sentinel endpoint for an empty sample row
+EMPTY_VERTEX = np.int32(-1)
+
+# hash-family salts (arbitrary distinct odd constants)
+SALT_BUCKET = 0x2545F491  # which of the R buckets an edge belongs to
+SALT_SAMPLE = 0x9E4C1B3B  # the within-bucket min-hash ranking
+SALT_MEMBER = 0x61C88647  # membership keys for emission-time closure checks
+SALT_CM_ROW = 0x7FEB352D  # count-min per-row hash family base
+SALT_EDGE_HLL = 0x45D9F3B5  # distinct-edge cardinality registers
+SALT_VERTEX_HLL = 0x119DE1F3  # distinct-vertex cardinality registers
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def mix32(x):
+    """murmur3 fmix32 finalizer on uint32 lanes (full avalanche)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_u32(x, salt: int):
+    """Salted 32-bit hash of integer lanes."""
+    return mix32(x.astype(jnp.uint32) ^ (jnp.uint32(salt) * GOLDEN))
+
+
+def hash_pair_u32(lo, hi, salt: int):
+    """Salted 32-bit hash of canonical (lo, hi) vertex pairs."""
+    h = mix32(lo.astype(jnp.uint32) ^ (jnp.uint32(salt) * GOLDEN))
+    return mix32(h ^ (hi.astype(jnp.uint32) * GOLDEN))
+
+
+def canonical_edge(src, dst):
+    """(lo, hi) with lo <= hi — undirected edge identity."""
+    lo = jnp.minimum(src, dst)
+    hi = jnp.maximum(src, dst)
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# HLL-style distinct-cardinality registers (max-merge monoid)
+
+
+def hll_num_registers(eps: float, floor: int = 64, cap: int = 1 << 16) -> int:
+    """Registers m for relative standard error ~1.04/sqrt(m) <= eps/2.
+
+    The factor 2 turns the standard error into a ~95% (two-sigma) bound, so
+    the declared (eps, delta<=0.05) contract holds without a median-of-means
+    stage.  pow2-clamped to [floor, cap]: the floor keeps every register
+    leaf shardable over the test mesh, the cap keeps "tiny state" honest.
+    """
+    m = next_pow2(math.ceil((2.08 / float(eps)) ** 2))
+    return max(floor, min(m, cap))
+
+
+def hll_init(m: int):
+    """Zero registers — the max-merge identity."""
+    return jnp.zeros((m,), jnp.int32)
+
+
+def hll_fold(regs, keys_u32, mask):
+    """Fold hashed keys into the registers (scatter-max; order-free).
+
+    ``keys_u32`` must already be salted hashes (``hash_u32`` /
+    ``hash_pair_u32``): register index is the low log2(m) bits, rank is
+    1 + leading-zero count of the remaining bits.
+    """
+    m = regs.shape[0]
+    p = int(math.log2(m))
+    idx = (keys_u32 & jnp.uint32(m - 1)).astype(jnp.int32)
+    # clz of (h >> p) counts p guaranteed-zero top bits: subtract them.
+    # h >> p == 0 gives clz 32 -> rank (32 - p) + 1, the saturating max.
+    rank = jax.lax.clz(keys_u32 >> p).astype(jnp.int32) - p + 1
+    rank = jnp.where(mask, rank, 0)
+    return regs.at[idx].max(rank)
+
+
+def hll_merge(a, b):
+    return jnp.maximum(a, b)
+
+
+def hll_alpha(m: int) -> float:
+    if m <= 16:
+        return 0.673
+    if m <= 32:
+        return 0.697
+    if m <= 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def hll_estimate(regs):
+    """Cardinality estimate (float32 scalar): harmonic-mean raw estimate
+    with the small-range linear-counting correction."""
+    m = regs.shape[0]
+    inv = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)))
+    raw = jnp.float32(hll_alpha(m) * m * m) / inv
+    zeros = jnp.sum(regs == 0).astype(jnp.float32)
+    linear = jnp.float32(m) * (
+        jnp.log(jnp.float32(m)) - jnp.log(jnp.maximum(zeros, 1.0))
+    )
+    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_linear, linear, raw)
+
+
+# ---------------------------------------------------------------------------
+# count-min counter grid (add-merge monoid), stored FLAT [d * w] so every
+# state leaf is 1-D and pow2-shardable under the generic sketch block layout
+
+
+def cm_dims(eps: float, delta: float, floor: int = 64, cap: int = 1 << 16):
+    """(depth d, width w): overcount <= eps * N with probability >= 1 - delta
+    (N = total increments), the standard e/eps x ln(1/delta) sizing."""
+    w = next_pow2(math.ceil(math.e / float(eps)))
+    w = max(floor, min(w, cap))
+    d = max(1, min(math.ceil(math.log(1.0 / float(delta))), 8))
+    return d, w
+
+
+def cm_init(d: int, w: int):
+    return jnp.zeros((d * w,), jnp.int32)
+
+
+def cm_fold(grid, d: int, w: int, keys, counts, mask):
+    """Scatter-add ``counts`` for each key into all d rows (order-free)."""
+    cnt = jnp.where(mask, counts, 0).astype(jnp.int32)
+    for r in range(d):
+        col = (hash_u32(keys, SALT_CM_ROW + r) & jnp.uint32(w - 1)).astype(
+            jnp.int32
+        )
+        grid = grid.at[r * w + col].add(cnt)
+    return grid
+
+
+def cm_merge(a, b):
+    return a + b
+
+
+def cm_query(grid, d: int, w: int, keys):
+    """Point estimate per key: min over the d row counters (int32 lanes)."""
+    est = None
+    for r in range(d):
+        col = (hash_u32(keys, SALT_CM_ROW + r) & jnp.uint32(w - 1)).astype(
+            jnp.int32
+        )
+        row = grid[r * w + col]
+        est = row if est is None else jnp.minimum(est, row)
+    return est
+
+
+# ---------------------------------------------------------------------------
+# min-hash edge sample (lexicographic-min-merge monoid) + sampled-triangle
+# closure counting — the order-free form of the neighborhood-sampling
+# triangle estimator (arXiv:1308.2166)
+
+
+def tri_rows(eps: float, delta: float, floor: int = 64, cap: int = 1 << 12):
+    """Sample rows R ~ 2 ln(1/delta) / eps^2 — the paper's R parallel
+    estimators sized for a Chebyshev/Chernoff-style (eps, delta) target,
+    pow2-clamped ([floor, cap]; the cap bounds the O(R^2 log R)
+    emission-time closure check)."""
+    r = next_pow2(math.ceil(2.0 * math.log(1.0 / float(delta)) / float(eps) ** 2))
+    return max(floor, min(r, cap))
+
+
+def tri_init(rows: int):
+    """(eh, elo, ehi): empty sample rows — the lexicographic-min identity."""
+    return (
+        jnp.full((rows,), EMPTY_HASH, jnp.uint32),
+        jnp.full((rows,), EMPTY_VERTEX, jnp.int32),
+        jnp.full((rows,), EMPTY_VERTEX, jnp.int32),
+    )
+
+
+def _row_take(eh_a, elo_a, ehi_a, eh_b, elo_b, ehi_b):
+    """True where row b lexicographically precedes row a on (hash, lo, hi).
+
+    The (lo, hi) tie-break makes the merge a total order even across 32-bit
+    hash collisions — commutativity (hence sharded-vs-solo bit-identity)
+    must not hinge on hashes being collision-free.
+    """
+    return (eh_b < eh_a) | (
+        (eh_b == eh_a)
+        & ((elo_b < elo_a) | ((elo_b == elo_a) & (ehi_b < ehi_a)))
+    )
+
+
+def tri_merge(a, b):
+    """Rowwise lexicographic min of two samples (commutative, idempotent)."""
+    eh_a, elo_a, ehi_a = a
+    eh_b, elo_b, ehi_b = b
+    take = _row_take(eh_a, elo_a, ehi_a, eh_b, elo_b, ehi_b)
+    return (
+        jnp.where(take, eh_b, eh_a),
+        jnp.where(take, elo_b, elo_a),
+        jnp.where(take, ehi_b, ehi_a),
+    )
+
+
+def tri_fold(sample, src, dst, mask):
+    """Fold an edge micro-batch into the R-row min-hash sample.
+
+    Each canonical edge belongs to exactly ONE bucket (bucket hash); within
+    the bucket the kept edge is the sample-hash argmin — a uniform sample of
+    the bucket's distinct edges, determined by the edge set alone.  The fold
+    reduces the batch to one winner per bucket (three segment-mins implement
+    the lexicographic argmin) and row-merges the winners into the state, so
+    arrival order and duplicate arrivals cannot change the result.
+    """
+    eh, elo, ehi = sample
+    rows = eh.shape[0]
+    lo, hi = canonical_edge(src, dst)
+    ok = mask & (lo != hi)  # self-loops close no wedges
+    bucket = (hash_pair_u32(lo, hi, SALT_BUCKET) & jnp.uint32(rows - 1)).astype(
+        jnp.int32
+    )
+    s = jnp.where(ok, hash_pair_u32(lo, hi, SALT_SAMPLE), EMPTY_HASH)
+    # lexicographic argmin per bucket: min hash, then min lo among hash
+    # winners, then min hi among (hash, lo) winners
+    bmin = jax.ops.segment_min(s, bucket, num_segments=rows)
+    on_h = ok & (s == bmin[bucket])
+    big = jnp.int32(np.iinfo(np.int32).max)
+    blo = jax.ops.segment_min(
+        jnp.where(on_h, lo, big), bucket, num_segments=rows
+    )
+    on_hl = on_h & (lo == blo[bucket])
+    bhi = jax.ops.segment_min(
+        jnp.where(on_hl, hi, big), bucket, num_segments=rows
+    )
+    won = bmin != EMPTY_HASH
+    winner = (
+        bmin,
+        jnp.where(won, blo, EMPTY_VERTEX),
+        jnp.where(won, bhi, EMPTY_VERTEX),
+    )
+    return tri_merge((eh, elo, ehi), winner)
+
+
+#: closure-check strip height: wedge pairs are enumerated in [BLOCK, R]
+#: strips so the emission-time scratch is O(BLOCK * R) — KB, not the O(R^2)
+#: a one-shot matrix would cost (which would dwarf the registers it prices)
+TRI_CLOSURE_BLOCK = 32
+
+
+def _closed_wedges_strip(lo_i, hi_i, v_i, not_self, elo, ehi, valid, keys):
+    """Closed-wedge count for one [B, R] strip of row pairs.
+
+    ``keys`` are the sample's SORTED 32-bit membership hashes; the closing
+    edge of each shared-vertex pair is looked up by searchsorted.
+    Membership by hash admits ~R^3/2^32 expected false closures —
+    deterministic noise well inside the declared eps at the clamped R, and
+    orders cheaper than exact pair membership.
+    """
+    lo_i, hi_i, v_i = lo_i[:, None], hi_i[:, None], v_i[:, None]
+    lo_j, hi_j = elo[None, :], ehi[None, :]
+    # distinct canonical edges share at most one vertex: the four incidence
+    # cases are mutually exclusive, each naming the closing pair
+    cases = (
+        (lo_i == lo_j, hi_i, hi_j),
+        (lo_i == hi_j, hi_i, lo_j),
+        (hi_i == lo_j, lo_i, hi_j),
+        (hi_i == hi_j, lo_i, lo_j),
+    )
+    shape = (lo_i.shape[0], elo.shape[0])
+    shared = jnp.zeros(shape, bool)
+    close_a = jnp.zeros(shape, elo.dtype)
+    close_b = jnp.zeros(shape, elo.dtype)
+    for cond, a, b in cases:
+        pick = cond & ~shared
+        close_a = jnp.where(pick, jnp.broadcast_to(a, shape), close_a)
+        close_b = jnp.where(pick, jnp.broadcast_to(b, shape), close_b)
+        shared = shared | cond
+    pair_ok = (
+        v_i
+        & valid[None, :]
+        & shared
+        & not_self
+        & (close_a != close_b)  # the two non-shared endpoints must differ
+    )
+    ckey = hash_pair_u32(
+        jnp.minimum(close_a, close_b),
+        jnp.maximum(close_a, close_b),
+        SALT_MEMBER,
+    )
+    pos = jnp.clip(jnp.searchsorted(keys, ckey), 0, keys.shape[0] - 1)
+    closed = pair_ok & (keys[pos] == ckey) & (ckey != EMPTY_HASH)
+    return jnp.sum(closed.astype(jnp.int32))
+
+
+def tri_sampled_closures(elo, ehi):
+    """Closed-wedge count among the sampled rows (3x the fully-sampled
+    triangle count, each unordered pair seen twice), int32 scalar.
+
+    O(R^2 log R) wedge enumeration over row pairs sharing a vertex, strip
+    by strip (``TRI_CLOSURE_BLOCK`` rows against all R) so the live
+    emission-time set stays O(BLOCK * R) — the scratch
+    ``emission_scratch`` prices.
+    """
+    rows = elo.shape[0]
+    block = min(TRI_CLOSURE_BLOCK, rows)
+    valid = elo != EMPTY_VERTEX
+    mkeys = jnp.where(valid, hash_pair_u32(elo, ehi, SALT_MEMBER), EMPTY_HASH)
+    sorted_keys = jnp.sort(mkeys)
+    col = jnp.arange(rows)
+
+    def body(i, acc):
+        start = i * block
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, start, block)
+        not_self = (start + jnp.arange(block))[:, None] != col[None, :]
+        return acc + _closed_wedges_strip(
+            sl(elo), sl(ehi), sl(valid), not_self, elo, ehi, valid,
+            sorted_keys,
+        )
+
+    total = jax.lax.fori_loop(0, rows // block, body, jnp.zeros((), jnp.int32))
+    # each ordered pair counted twice; each triangle has 3 unordered pairs
+    return total // 2
+
+
+def tri_estimate(sample, regs):
+    """Triangle-count estimate from the sample + distinct-edge registers.
+
+    Exactly ``occ`` of the ~E distinct edges are sampled (one per occupied
+    bucket, uniform within the bucket), so a given edge survives with
+    p = occ/E and a triangle with ~p^3.  The estimate is
+    closures/3 / min(p, 1)^3 — and when the sample covers every distinct
+    edge (p = 1) it degrades to the EXACT triangle count.
+    """
+    eh, elo, ehi = sample
+    occ = jnp.sum(eh != EMPTY_HASH).astype(jnp.float32)
+    distinct_edges = hll_estimate(regs)
+    p = jnp.minimum(occ / jnp.maximum(distinct_edges, 1.0), 1.0)
+    closures = tri_sampled_closures(elo, ehi).astype(jnp.float32)
+    triangles = closures / 3.0
+    return (
+        triangles / jnp.maximum(p, 1e-9) ** 3,
+        occ.astype(jnp.int32),
+        distinct_edges,
+    )

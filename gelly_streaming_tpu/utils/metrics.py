@@ -1060,6 +1060,88 @@ def reset_spmv_stats() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Sketch-summary accounting (library/sketches.py).  Every sketch job carries
+# a declared (eps, delta) error contract and a fixed-tiny-state footprint —
+# both belong in the observability plane so an operator can see WHICH jobs
+# are approximate, at what accuracy, and how many exact-job state budgets
+# one chip's sketch tenancy replaced.  Registrations come from the server's
+# submit thread while scrapes come from metrics/bench threads, so the lock
+# carries the same discipline as every registry above.
+
+
+_SKETCH_LOCK = threading.Lock()
+
+
+def _sketch_zero() -> dict:
+    return {
+        # sketch jobs admitted since the last reset
+        "sketch_jobs_registered": 0,
+        # persistent summary bytes across registered sketch jobs
+        "sketch_state_bytes": 0,
+        # admission-priced bytes (state + declared emission scratch) —
+        # the figure the admission caps actually charged
+        "sketch_admission_bytes": 0,
+    }
+
+
+_SKETCH = _sketch_zero()  # guarded-by: _SKETCH_LOCK
+# job key -> {"kind", "eps", "delta", "state_bytes", "admission_bytes"}
+_SKETCH_JOBS: dict = {}  # guarded-by: _SKETCH_LOCK
+
+
+def sketch_register(
+    job: str,
+    kind: str,
+    eps: float,
+    delta: float,
+    state_bytes: int,
+    admission_bytes: int,
+) -> None:
+    """Record one admitted sketch job and its (eps, delta) contract.
+
+    Re-registering a job key (resubmit after cancel) replaces its row
+    without double-counting the byte totals."""
+    with _SKETCH_LOCK:
+        old = _SKETCH_JOBS.get(job)
+        if old is not None:
+            _SKETCH["sketch_state_bytes"] -= old["sketch_state_bytes"]
+            _SKETCH["sketch_admission_bytes"] -= old["sketch_admission_bytes"]
+        else:
+            _SKETCH["sketch_jobs_registered"] += 1
+        _SKETCH_JOBS[job] = {
+            "kind": kind,
+            "sketch_eps": float(eps),
+            "sketch_delta": float(delta),
+            "sketch_state_bytes": int(state_bytes),
+            "sketch_admission_bytes": int(admission_bytes),
+        }
+        _SKETCH["sketch_state_bytes"] += int(state_bytes)
+        _SKETCH["sketch_admission_bytes"] += int(admission_bytes)
+
+
+def sketch_stats() -> dict:
+    """Process-wide sketch-tenancy figures: registered job count and the
+    summed persistent/admission byte footprints of every live contract."""
+    with _SKETCH_LOCK:
+        return dict(_SKETCH)
+
+
+def all_sketch_stats() -> dict:
+    """Per-job contract rows: kind, declared (eps, delta), and the
+    state/admission byte prices the job was admitted at."""
+    with _SKETCH_LOCK:
+        return {j: dict(row) for j, row in _SKETCH_JOBS.items()}
+
+
+def reset_sketch_stats() -> None:
+    """Forget every sketch contract (tests and bench measurement windows)."""
+    global _SKETCH
+    with _SKETCH_LOCK:
+        _SKETCH = _sketch_zero()
+        _SKETCH_JOBS.clear()
+
+
+# ---------------------------------------------------------------------------
 # exposition: one snapshot of every registry, plus a Prometheus renderer
 
 
@@ -1080,6 +1162,8 @@ def metrics_snapshot() -> dict:
         "compile_cache": compile_cache_stats(),
         "fused": fused_dispatch_stats(),
         "spmv": spmv_stats(),
+        "sketch": sketch_stats(),
+        "sketch_jobs": all_sketch_stats(),
         "jobs": all_job_stats(),
         "job_totals": job_totals(),
         "tenants": all_tenant_stats(),
@@ -1144,6 +1228,7 @@ def render_prometheus(snap: Optional[dict] = None) -> str:
         "compile_cache",
         "fused",
         "spmv",
+        "sketch",
         "events",
     ):
         for key, val in sorted(snap.get(section, {}).items()):
@@ -1154,6 +1239,7 @@ def render_prometheus(snap: Optional[dict] = None) -> str:
         ("tenants", "tenant"),
         ("health", "job"),
         ("scale", "job"),
+        ("sketch_jobs", "job"),
     ):
         rows = snap.get(scope_key, {})
         keys = sorted(

@@ -1,0 +1,27 @@
+"""Seeded bug: the sketch-summary contract registry mutated lock-free.
+
+Models utils/metrics.py's sketch registry (ISSUE 19): byte totals and the
+per-job contract table are '# guarded-by:' the registry lock because
+registrations land from the server's submit thread while scrapes drain
+from metrics/bench threads.  Expected findings: exactly two UNGUARDED —
+the totals dict bumped and the contract row installed without
+'with _SKETCH_LOCK:'.  Analyzer input only — never imported.
+"""
+
+import threading
+
+_SKETCH_LOCK = threading.Lock()
+_SKETCH = {"sketch_state_bytes": 0}  # guarded-by: _SKETCH_LOCK
+_SKETCH_JOBS = {}  # guarded-by: _SKETCH_LOCK
+
+
+def sketch_register(job, kind, state_bytes):
+    # BUG: lost-update window — a concurrent register reads the same total
+    _SKETCH["sketch_state_bytes"] += state_bytes
+    # BUG: a concurrent snapshot iterates the dict mid-insert
+    _SKETCH_JOBS[job] = {"kind": kind, "state_bytes": state_bytes}
+
+
+def sketch_stats():
+    with _SKETCH_LOCK:
+        return dict(_SKETCH)

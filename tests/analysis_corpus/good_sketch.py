@@ -1,0 +1,33 @@
+"""Clean counterpart: every sketch-registry access holds the registry lock
+(the utils/metrics.py discipline the sketch contract table ships with).
+
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+_SKETCH_LOCK = threading.Lock()
+_SKETCH = {"sketch_state_bytes": 0}  # guarded-by: _SKETCH_LOCK
+_SKETCH_JOBS = {}  # guarded-by: _SKETCH_LOCK
+
+
+def sketch_register(job, kind, state_bytes):
+    with _SKETCH_LOCK:
+        _SKETCH["sketch_state_bytes"] += state_bytes
+        _SKETCH_JOBS[job] = {"kind": kind, "state_bytes": state_bytes}
+
+
+def sketch_stats():
+    with _SKETCH_LOCK:
+        return dict(_SKETCH)
+
+
+def all_sketch_stats():
+    with _SKETCH_LOCK:
+        return {j: dict(row) for j, row in _SKETCH_JOBS.items()}
+
+
+def reset_sketch_stats():
+    with _SKETCH_LOCK:
+        _SKETCH["sketch_state_bytes"] = 0
+        _SKETCH_JOBS.clear()

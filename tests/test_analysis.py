@@ -167,6 +167,17 @@ def test_corpus_unguarded():
     assert _analyze("good_unguarded.py") == []
 
 
+def test_corpus_sketch():
+    # the ISSUE 19 sketch contract registry's lock discipline: byte totals
+    # and the per-job contract table mutate only under the registry lock
+    # (submit-thread registrations race metrics/bench-thread scrapes)
+    findings = _analyze("bad_sketch.py")
+    assert _codes(findings) == ["UNGUARDED", "UNGUARDED"]
+    assert "_SKETCH" in findings[0].message
+    assert "_SKETCH_JOBS" in findings[1].message
+    assert _analyze("good_sketch.py") == []
+
+
 def test_corpus_jobstate():
     """The runtime fixtures (ISSUE 5): job lifecycle state is
     '# guarded-by:' the manager lock; a transition outside it is exactly

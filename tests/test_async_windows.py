@@ -459,3 +459,42 @@ def test_arena_pool_never_blocks():
     pool = async_exec.ArenaPool(per_shape=1)
     bufs = [pool.acquire((4,), np.int32) for _ in range(16)]
     assert len({id(b) for b in bufs}) == 16
+
+
+def test_drain_waits_on_fold_output_not_record(monkeypatch):
+    """Regression: the drain's arena-release wait must target the FOLD
+    OUTPUT pytree.  CC's transform wraps state in a DisjointSet — not a
+    registered pytree — so ``wait_ready`` on the emission record sees one
+    opaque leaf and silently waits on nothing, recycling the window's
+    arenas under a still-pending zero-copy fold (the corrupted-parents
+    flake in test_runtime's four-jobs async parity)."""
+    import jax
+
+    waited = []
+    real = async_exec.wait_ready
+
+    def spy(tree):
+        waited.append(tree)
+        real(tree)
+
+    monkeypatch.setattr(async_exec, "wait_ready", spy)
+    # batch misaligned to the window so the stream rides the windowed
+    # (arena-backed) plane, not the packed-wire fast path
+    cfg = dataclasses.replace(
+        StreamConfig(vertex_capacity=64, batch_size=24, ingest_window_edges=32),
+        async_windows=2,
+    )
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 64, 256).astype(np.int32)
+    dst = rng.integers(0, 64, 256).astype(np.int32)
+    recs = list(
+        EdgeStream.from_arrays(src, dst, cfg).aggregate(ConnectedComponents())
+    )
+    assert recs
+    assert waited, "drain released arenas without waiting on anything"
+    for tree in waited:
+        leaves = jax.tree.leaves(tree)
+        assert leaves, "wait target flattened to nothing"
+        assert all(
+            hasattr(leaf, "block_until_ready") for leaf in leaves
+        ), f"wait target has un-waitable leaves: {tree!r}"

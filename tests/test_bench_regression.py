@@ -112,6 +112,18 @@ def test_direction_rules():
     assert bench._bench_direction("spmv_push_iters") is None
     assert bench._bench_direction("spmv_density_hist_0") is None
     assert bench._bench_direction("spmv_direction_switches") is None
+    # the sketch-summary headlines (ISSUE 19): the tenancy ratio and the
+    # sketch aggregate eps regress downward, the triangle relative error
+    # and the retrace guard upward; raw admission counts and the exact
+    # triangle figure are informational
+    assert bench._bench_direction("sketch_tenancy_ratio") == "higher"
+    assert bench._bench_direction("sketch_agg_eps_16") == "higher"
+    assert bench._bench_direction("sketch_triangle_rel_err") == "lower"
+    assert bench._bench_direction("sketch_recompiles_after_warm") == "lower"
+    assert bench._bench_direction("sketch_compiles_after_warm") is None
+    assert bench._bench_direction("sketch_admitted") is None
+    assert bench._bench_direction("sketch_exact_admitted") is None
+    assert bench._bench_direction("sketch_triangle_exact") is None
 
 
 def test_fresh_at_best_passes(baselines, capsys):

@@ -1,0 +1,512 @@
+"""Sketch summary family (ISSUE 19): fixed-tiny-state approximate
+descriptors as ORDINARY summaries on every existing plane.
+
+The contracts under test:
+
+* ACCURACY — each sketch's estimate lands within its declared (eps, delta)
+  of the exact oracle on seeded hub-heavy streams (deterministic: seeded
+  edges + salted hashing make every estimate a pinned constant, so these
+  are equality-class assertions, not statistical ones).
+* MERGEABILITY — every sketch state is a commutative monoid: partial folds
+  combine to the same bits in any order, and the owner-sharded plane
+  (S = 8 modulo register blocks) emits BIT-IDENTICAL records to the
+  replicated oracle with zero sketch-specific machinery.
+* RECOVERY — positional checkpoints + kill-mid-stream resume parity, the
+  same at-least-once story the exact summaries pin.
+* ELASTICITY — ``reshard_summary(..., rows="auto")`` re-routes the
+  register blocks S -> 2S -> S bit-exactly even though the leaves carry
+  DIFFERENT pow2 row counts.
+* 0-RECOMPILE — 50 same-width panes and 1 -> 16-job fused tenancy drift
+  compile nothing after warmup (pow2 register shapes + shared
+  ``cache_token`` per contract).
+* ADMISSION — ``admission_nbytes`` prices the emission-time residue (the
+  count-min top-k's O(C) gathered view) on top of the persistent KBs, and
+  the manager refuses at exactly that byte figure.
+* SERVING — ``summary: <kind>`` + ``eps``/``delta`` knobs ride job specs;
+  malformed contracts refuse loudly at admission with a typed error.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core import compile_cache
+from gelly_streaming_tpu.core.config import RuntimeConfig, StreamConfig
+from gelly_streaming_tpu.core.sharded_state import reshard_summary
+from gelly_streaming_tpu.core.stream import EdgeStream
+from gelly_streaming_tpu.library.sketches import (
+    SKETCH_KINDS,
+    CountMinHeavyHitters,
+    HLLDegreeSummary,
+    SketchParamError,
+    SketchTriangleCount,
+    make_sketch,
+)
+
+pytestmark = pytest.mark.timeout_cap(300)
+
+CAP = 64
+S = 8
+
+
+def _cfg(**kw):
+    base = dict(
+        vertex_capacity=CAP, batch_size=64, num_shards=S, window_ms=1000
+    )
+    base.update(kw)
+    return StreamConfig(**base)
+
+
+def _both(cfg):
+    return (
+        dataclasses.replace(cfg, sharded_state=1),
+        dataclasses.replace(cfg, sharded_state=0),
+    )
+
+
+def _rand_edges(n, seed=0, cap=CAP):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, cap, n).astype(np.int32),
+        rng.integers(0, cap, n).astype(np.int32),
+    )
+
+
+def _skewed_edges(n, cap, seed=7):
+    """Hub-heavy, community-clustered edges (the bench's skew model)."""
+    rng = np.random.default_rng(seed)
+    comm = max(cap >> 14, 64)
+    cbase = ((cap * rng.random(n) ** 2).astype(np.int64) // comm) * comm
+    s = cbase + (comm * rng.random(n) ** 2).astype(np.int64)
+    d = cbase + (comm * rng.random(n) ** 4).astype(np.int64)
+    return (s % cap).astype(np.int32), (d % cap).astype(np.int32)
+
+
+def _timed_edges(n, seed=0, span_ms=3000, cap=CAP):
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.integers(0, span_ms, n)).astype(np.int64)
+    s, d = _rand_edges(n, seed, cap)
+    return [(int(s[i]), int(d[i]), 0.0, int(t[i])) for i in range(n)]
+
+
+def _leaves(x):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(x)]
+
+
+def _records_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        la, lb = _leaves(ra), _leaves(rb)
+        assert len(la) == len(lb)
+        for x, y in zip(la, lb):
+            assert np.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# accuracy: estimates within the declared (eps, delta) of exact oracles
+
+
+def test_hll_degree_within_contract():
+    cap, n = 4096, 20_000
+    src, dst = _rand_edges(n, seed=5, cap=cap)
+    cfg = StreamConfig(
+        vertex_capacity=cap, batch_size=2048, ingest_window_edges=n
+    )
+    agg = HLLDegreeSummary(eps=0.05, delta=0.05)
+    recs = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
+    v_est = float(np.asarray(recs[-1][0]))
+    e_est = float(np.asarray(recs[-1][1]))
+    exact_v = len(np.unique(np.concatenate([src, dst])))
+    lo, hi = np.minimum(src, dst), np.maximum(src, dst)
+    exact_e = len(np.unique(lo.astype(np.int64) * cap + hi))
+    assert abs(v_est - exact_v) / exact_v < agg.eps
+    assert abs(e_est - exact_e) / exact_e < agg.eps
+
+
+def test_cm_heavy_hitters_within_contract():
+    cap, n = 512, 20_000
+    src, dst = _skewed_edges(n, cap, seed=9)
+    cfg = StreamConfig(
+        vertex_capacity=cap, batch_size=2048, ingest_window_edges=n
+    )
+    agg = CountMinHeavyHitters(eps=0.01, delta=0.02, top_k=16)
+    recs = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
+    ids = np.asarray(recs[-1][0])
+    est = np.asarray(recs[-1][1])
+    deg = np.bincount(src, minlength=cap) + np.bincount(dst, minlength=cap)
+    # count-min never undercounts, and the overcount stays within eps of
+    # the total mass (2 endpoint increments per edge)
+    assert np.all(est >= deg[ids])
+    assert np.all(est - deg[ids] <= agg.eps * 2 * n)
+    # the true heaviest vertices all surface in the top-k report
+    true_top8 = set(np.argsort(deg)[-8:].tolist())
+    assert true_top8 <= set(ids.tolist())
+
+
+def test_triangle_estimate_within_contract():
+    cap, n = 256, 40 << 10
+    src, dst = _skewed_edges(n, cap, seed=7)
+    cfg = StreamConfig(
+        vertex_capacity=cap, batch_size=1 << 12, ingest_window_edges=n
+    )
+    agg = SketchTriangleCount(eps=0.05, delta=0.05)
+    recs = EdgeStream.from_arrays(src, dst, cfg).aggregate(agg).collect()
+    est = float(np.asarray(recs[-1][0]))
+    adj = np.zeros((cap, cap), dtype=np.int64)
+    keep = src != dst
+    adj[src[keep], dst[keep]] = 1
+    adj = np.maximum(adj, adj.T)
+    exact = int(np.trace(adj @ adj @ adj)) // 6
+    assert exact > 0
+    assert abs(est - exact) / exact < agg.eps
+
+
+# ---------------------------------------------------------------------------
+# mergeability: commutative-monoid combine, order-free
+
+
+@pytest.mark.parametrize("kind", SKETCH_KINDS)
+def test_combine_order_free_bit_identity(kind):
+    import jax.numpy as jnp
+
+    agg = make_sketch(kind)
+    cfg = _cfg()
+    parts = []
+    for seed in range(4):
+        s, d = _rand_edges(128, seed=seed)
+        st = agg.update(
+            agg.initial_state(cfg),
+            jnp.asarray(s),
+            jnp.asarray(d),
+            None,
+            jnp.ones(len(s), bool),
+        )
+        parts.append(st)
+    fwd = parts[0]
+    for p in parts[1:]:
+        fwd = agg.combine(fwd, p)
+    rev = parts[3]
+    for p in (parts[1], parts[2], parts[0]):
+        rev = agg.combine(rev, p)
+    for x, y in zip(_leaves(fwd), _leaves(rev)):
+        assert np.array_equal(x, y)
+
+
+@pytest.mark.parametrize("kind", SKETCH_KINDS)
+@pytest.mark.parametrize("seed", [3, 11])
+def test_sharded_emissions_match_replicated_oracle(kind, seed):
+    """The tentpole claim: the owner-sharded plane (S = 8 modulo register
+    blocks, slab exchange, lazy gather) emits records bit-identical to the
+    replicated combine — with the sketch as a plain descriptor."""
+    src, dst = _rand_edges(512, seed=seed)
+    on, off = _both(_cfg())
+    got = (
+        EdgeStream.from_arrays(src, dst, on)
+        .aggregate(make_sketch(kind))
+        .collect()
+    )
+    exp = (
+        EdgeStream.from_arrays(src, dst, off)
+        .aggregate(make_sketch(kind))
+        .collect()
+    )
+    _records_equal(got, exp)
+
+
+# ---------------------------------------------------------------------------
+# recovery: kill mid-stream, resume from the positional checkpoint
+
+
+def test_windowed_kill_and_resume_parity(tmp_path):
+    edges = _timed_edges(160, seed=12)
+    on, off = _both(_cfg(batch_size=16))
+    full = [
+        _leaves(o)
+        for o in EdgeStream.from_collection(
+            edges, on, 16, with_time=True
+        ).aggregate(HLLDegreeSummary())
+    ]
+
+    def killed_then_resumed(cfg, ckpt):
+        it = iter(
+            EdgeStream.from_collection(
+                edges, cfg, 16, with_time=True
+            ).aggregate(HLLDegreeSummary(), checkpoint_path=ckpt)
+        )
+        first_two = [_leaves(next(it)), _leaves(next(it))]
+        it.close()
+        assert os.path.exists(ckpt)
+        resumed = [
+            _leaves(o)
+            for o in EdgeStream.from_collection(
+                edges, cfg, 16, with_time=True
+            ).aggregate(HLLDegreeSummary(), checkpoint_path=ckpt)
+        ]
+        return first_two, resumed
+
+    def eq(a, b):
+        assert len(a) == len(b)
+        for la, lb in zip(a, b):
+            for x, y in zip(la, lb):
+                assert np.array_equal(x, y)
+
+    first_on, resumed_on = killed_then_resumed(
+        on, os.path.join(str(tmp_path), "sharded.npz")
+    )
+    first_off, resumed_off = killed_then_resumed(
+        off, os.path.join(str(tmp_path), "replicated.npz")
+    )
+    eq(first_on, full[:2])
+    # window 1's snapshot never landed (killed at the yield): it re-emits —
+    # at-least-once, identical on both planes
+    eq(resumed_on, full[1:])
+    eq(resumed_on, resumed_off)
+
+
+# ---------------------------------------------------------------------------
+# elasticity: register blocks re-route S -> 2S -> S bit-exactly
+
+
+@pytest.mark.parametrize("kind", SKETCH_KINDS)
+def test_reshard_auto_round_trip(kind):
+    import jax.numpy as jnp
+
+    agg = make_sketch(kind)
+    cfg = _cfg()
+    s, d = _rand_edges(256, seed=2)
+    state = agg.update(
+        agg.initial_state(cfg),
+        jnp.asarray(s),
+        jnp.asarray(d),
+        None,
+        jnp.ones(len(s), bool),
+    )
+    spec = agg.sharded_state_spec(cfg)
+    blocks_4 = spec.shard_summary(state, cfg, 4)
+    # reshard == shard at the new geometry, leaf for leaf (the consistency
+    # oracle reshard_summary's docstring pins), despite per-leaf row counts
+    # differing across the pytree (sample rows vs registers vs cm cells)
+    rerouted_8 = reshard_summary(blocks_4, cfg, 4, 8, rows="auto")
+    direct_8 = spec.shard_summary(state, cfg, 8)
+    for x, y in zip(_leaves(rerouted_8), _leaves(direct_8)):
+        assert np.array_equal(x, y)
+    back_4 = reshard_summary(rerouted_8, cfg, 8, 4, rows="auto")
+    for x, y in zip(_leaves(back_4), _leaves(blocks_4)):
+        assert np.array_equal(x, y)
+
+
+def test_reshard_auto_rejects_uneven_geometry():
+    agg = HLLDegreeSummary()
+    cfg = _cfg()
+    blocks = agg.sharded_state_spec(cfg).initial_shard_state(cfg, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        reshard_summary(blocks, cfg, 4, 3, rows="auto")
+
+
+# ---------------------------------------------------------------------------
+# 0-recompile: same-width panes and fused tenancy drift retrace nothing
+
+
+def test_zero_compiles_across_50_same_width_panes():
+    cfg = StreamConfig(
+        vertex_capacity=1 << 10, batch_size=256, ingest_window_edges=256
+    )
+    agg = HLLDegreeSummary()
+
+    def run(windows):
+        s, d = _rand_edges(windows * 256, seed=21, cap=1 << 10)
+        return (
+            EdgeStream.from_arrays(s, d, cfg)
+            .aggregate(HLLDegreeSummary())
+            .collect()
+        )
+
+    run(3)  # warmup: fold + transform executables land here
+    compile_cache.reset_stats()
+    out = run(50)
+    assert len(out) == 50
+    stats = compile_cache.stats()
+    assert stats["compiles"] == 0
+    assert stats["recompiles"] == 0
+    del agg
+
+
+def test_zero_compiles_across_fused_tenancy_drift():
+    """1 -> 16 sketch jobs under the fused-dispatch manager: with the solo
+    chain and every pow2 cohort row bucket warm, tenancy drift compiles
+    NOTHING, let alone retraces.  Buckets are warmed explicitly (the
+    test_fused_dispatch idiom) — cohort sizes at dispatch time depend on
+    scheduler timing, so a run-shaped warmup can miss a bucket."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.runtime import JobManager
+
+    win = 256
+    cfg = StreamConfig(
+        vertex_capacity=1 << 10,
+        batch_size=(win // 2) + 32,  # misaligned: the windowed plane runs
+        ingest_window_edges=win,
+        fused_dispatch=1,
+    )
+    datasets = [_rand_edges(4 * win, seed=30 + i, cap=1 << 10) for i in range(16)]
+
+    def run(n_jobs):
+        with JobManager(RuntimeConfig(max_jobs=16, fair_quantum=4)) as m:
+            for i in range(n_jobs):
+                m.submit_aggregation(
+                    EdgeStream.from_arrays(*datasets[i], cfg),
+                    HLLDegreeSummary(),
+                    name=f"drift-{n_jobs}x-{i}",
+                    sink=lambda rec: np.asarray(rec[0]),
+                )
+            m.wait_all()
+
+    run(1)  # warm the solo update/combine/transform chain
+    agg = HLLDegreeSummary()
+    fold = agg._superpane_fold_fn(cfg, False)
+    for rows in (2, 4, 8, 16):
+        states = fold(
+            jnp.zeros((rows, win), jnp.int32),
+            jnp.zeros((rows, win), jnp.int32),
+            None,
+            jnp.zeros((rows, win), bool),
+        )
+        agg._superpane_split_fn(cfg, rows)(states)
+    compile_cache.reset_stats()
+    run(16)
+    run(1)
+    stats = compile_cache.stats()
+    assert stats["compiles"] == 0, stats
+    assert stats["recompiles"] == 0, stats
+
+
+# ---------------------------------------------------------------------------
+# admission: emission-time residue is priced, refusal at the exact byte cap
+
+
+def test_admission_prices_emission_scratch_at_exact_cap():
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.runtime.job import AdmissionError
+
+    cap = 1 << 12
+    cfg = StreamConfig(
+        vertex_capacity=cap, batch_size=256, ingest_window_edges=256
+    )
+    agg = CountMinHeavyHitters()
+    state = agg.state_nbytes(cfg)
+    adm = agg.admission_nbytes(cfg)
+    # the top-k's O(C) gathered estimate view dwarfs the persistent grid
+    assert adm > state
+    assert adm - state >= 4 * cap
+    s, d = _rand_edges(256, seed=40, cap=cap)
+
+    def submit(max_bytes):
+        with JobManager(
+            RuntimeConfig(max_jobs=2, max_state_bytes=max_bytes)
+        ) as m:
+            m.submit_aggregation(
+                EdgeStream.from_arrays(s, d, cfg),
+                CountMinHeavyHitters(),
+                name=f"adm-{max_bytes}",
+                sink=lambda rec: None,
+            )
+            m.wait_all()
+
+    submit(adm)  # exactly the admission price: fits
+    with pytest.raises(AdmissionError):
+        submit(adm - 1)  # one byte short: the residue must be charged
+
+
+# ---------------------------------------------------------------------------
+# serving: sketch kinds + knobs in job specs, typed refusals at admission
+
+
+def test_server_sketch_submit_contract_and_refusals():
+    from gelly_streaming_tpu.core.config import ServerConfig
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.runtime.client import GellyClient, ServerRefused
+    from gelly_streaming_tpu.runtime.server import StreamServer
+    from gelly_streaming_tpu.utils import metrics
+
+    cap, w, b = 1 << 12, 1 << 10, 1 << 9
+    src, dst = _rand_edges(4 * w, seed=50, cap=cap)
+    metrics.reset_sketch_stats()
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as server:
+        with GellyClient("127.0.0.1", server.port) as c:
+            # malformed knobs and unknown kinds refuse LOUDLY and typed —
+            # never a hang, never a silent exact fallback
+            with pytest.raises(ServerRefused) as ei:
+                c.submit(
+                    name="bad-eps",
+                    summary="hll_degree",
+                    eps=2.0,
+                    capacity=cap,
+                    window_edges=w,
+                    batch=b,
+                )
+            assert ei.value.code == "bad-spec"
+            with pytest.raises(ServerRefused) as ei:
+                c.submit(name="bad-kind", summary="bloom", capacity=cap)
+            assert ei.value.code == "bad-spec"
+            r = c.submit(
+                name="hll",
+                summary="hll_degree",
+                eps=0.05,
+                delta=0.05,
+                capacity=cap,
+                window_edges=w,
+                batch=b,
+            )
+            assert r["error_contract"] == {
+                "kind": "hll_degree",
+                "eps": 0.05,
+                "delta": 0.05,
+            }
+            # exact queries carry no contract
+            r2 = c.submit(
+                name="cc",
+                query="cc",
+                capacity=cap,
+                window_edges=w,
+                batch=b,
+            )
+            assert r2["error_contract"] is None
+            c.push_edges("hll", src, dst, batch=b, capacity=cap)
+            recs = list(c.iter_results("hll", deadline_s=120))
+            assert len(recs) == 4
+            st = c.call({"verb": "status"})[0]
+            row = st["sketch_jobs"]["default/hll"]
+            assert row["kind"] == "hll_degree"
+            assert row["sketch_eps"] == 0.05
+            assert row["sketch_admission_bytes"] >= row["sketch_state_bytes"]
+            snap = c.call({"verb": "metrics"})[0]["metrics"]
+            assert snap["sketch"]["sketch_jobs_registered"] == 1
+
+
+def test_make_sketch_validation_and_state_scale():
+    with pytest.raises(SketchParamError, match="unknown sketch kind"):
+        make_sketch("bloom")
+    with pytest.raises(SketchParamError, match="eps"):
+        make_sketch("hll_degree", eps=0.0)
+    with pytest.raises(SketchParamError, match="delta"):
+        make_sketch("sketch_triangles", delta=1.0)
+    with pytest.raises(SketchParamError, match="top_k"):
+        make_sketch("cm_heavy_hitters", top_k=0)
+    small = StreamConfig(vertex_capacity=1 << 10, batch_size=256)
+    big = StreamConfig(vertex_capacity=1 << 20, batch_size=256)
+    for kind in SKETCH_KINDS:
+        agg = make_sketch(kind)
+        # the tentpole economics: persistent state is a function of the
+        # (eps, delta) contract, NOT of vertex_capacity — KB, not MB
+        assert agg.state_nbytes(small) == agg.state_nbytes(big)
+        assert agg.state_nbytes(big) < 256 << 10
+        assert agg.error_contract()["kind"] == kind
+    # the count-min emission residue is the one capacity-coupled price
+    cm = make_sketch("cm_heavy_hitters")
+    assert cm.admission_nbytes(big) > cm.admission_nbytes(small)
+    hll = make_sketch("hll_degree")
+    assert hll.admission_nbytes(big) == hll.admission_nbytes(small)
