@@ -127,6 +127,16 @@ community graph (the direction-optimization headline),
 ``spmv_recompiles_after_warm`` the retrace guard across density drift and
 direction flips; the ``spmv_*`` registry counters
 (utils/metrics.spmv_stats) ride along as info keys.
+
+Fleet-tier keys (ISSUE 20; GELLY_BENCH_FLEET=0 skips):
+``fleet_agg_eps_{1,2,4}`` is aggregate router-fronted throughput at 4
+clients per backend over 1/2/4 subprocess backends
+(``fleet_scaling_ratio`` the 4-vs-1 multiple), ``router_overhead_p50_ms``
+the placed-verb RTT tax of the extra hop (results, not ping — the router
+answers ping locally), ``fleet_failover_downtime_ms`` the SIGKILL ->
+standby takeover -> first-accepted-push gap through one router address,
+and ``fleet_warm_recompiles`` the same-shape retrace guard behind the
+router (target 0).  GELLY_BENCH_FLEET_WINDOWS / _WIN_EDGES scale it.
 """
 
 import ctypes
@@ -1265,6 +1275,307 @@ def _rescale_bench(
     return out
 
 
+def _fleet_bench(
+    backends=(1, 2, 4), windows: int = 8, win_edges: int = 1 << 12,
+    capacity: int = 1 << 14, clients_per_backend: int = 4,
+):
+    """Fleet serving tier sweep (ISSUE 20): router scaling + failover.
+
+    Four figures, all through one ``gelly-router`` front address:
+
+    * ``fleet_agg_eps_{1,2,4}`` — aggregate throughput with 4 clients per
+      backend over 1/2/4 SUBPROCESS backends (separate interpreters =
+      real compute scaling, not GIL-shared threads), placement spread by
+      the rendezvous hash; ``fleet_scaling_ratio`` pins the 4-vs-1
+      multiple the tier exists to deliver.
+    * ``router_overhead_p50_ms`` — the extra hop's tax on a PLACED verb
+      (``results`` with ``timeout_ms=0``): p50 RTT through the router
+      minus p50 RTT direct to the same backend.  NOT measured on ping,
+      which the router answers locally without touching a backend.
+    * ``fleet_failover_downtime_ms`` — SIGKILL the only serving backend
+      mid-stream, let the probe->failover->takeover chain run, and time
+      kill -> first ACCEPTED push of the resilient client through the
+      same router address (includes the standby's resubmit + resync).
+    * ``fleet_warm_recompiles`` — the 0-recompile guarantee survives the
+      router hop: a second same-shape job behind an in-process backend
+      must land entirely in the executable cache.
+    """
+    import shutil
+    import subprocess
+    import threading
+
+    from gelly_streaming_tpu.core import compile_cache
+    from gelly_streaming_tpu.core.config import RuntimeConfig, ServerConfig
+    from gelly_streaming_tpu.runtime import JobManager
+    from gelly_streaming_tpu.runtime.client import GellyClient
+    from gelly_streaming_tpu.runtime.fleet import (
+        BackendSpec,
+        Fleet,
+        FleetConfig,
+    )
+    from gelly_streaming_tpu.runtime.router import GLYRouter, RouterConfig
+    from gelly_streaming_tpu.runtime.server import StreamServer
+
+    n = windows * win_edges
+    bs = win_edges // 2
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+
+    def spawn(bdir, extra=()):
+        os.makedirs(bdir, exist_ok=True)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "gelly_streaming_tpu.runtime.serve",
+                "--listen", "127.0.0.1:0",
+                "--checkpoint-prefix", os.path.join(bdir, "ck"),
+                "--status-interval", "0", *extra,
+            ],
+            env=env, stderr=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        )
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stderr.readline().decode()
+            if "listening on" in line:
+                port = int(line.rsplit(":", 1)[1])
+                break
+            if not line and proc.poll() is not None:
+                break
+        if port is None:
+            proc.kill()
+            raise RuntimeError("fleet bench backend never reported its port")
+        return proc, port
+
+    rng = np.random.default_rng(23)
+    max_k = max(backends) * clients_per_backend
+    datasets = [
+        (
+            rng.integers(0, capacity, n).astype(np.int32),
+            rng.integers(0, capacity, n).astype(np.int32),
+        )
+        for _ in range(max_k)
+    ]
+    out = {}
+    td = tempfile.mkdtemp(prefix="fleet_bench_")
+    procs = []
+    try:
+        # ---- subprocess pool: spawn once, warm once, sweep subsets ----
+        ports = []
+        for b in range(max(backends)):
+            proc, port = spawn(os.path.join(td, f"b{b + 1}"))
+            procs.append(proc)
+            ports.append(port)
+        for b, port in enumerate(ports):
+            ws, wd = datasets[b % max_k]
+            with GellyClient("127.0.0.1", port) as c:
+                c.submit(
+                    name="warm", query="edges", capacity=capacity,
+                    window_edges=win_edges, batch=bs,
+                )
+                c.push_edges(
+                    "warm", ws[: 2 * win_edges], wd[: 2 * win_edges],
+                    batch=bs, capacity=capacity, bdv=True,
+                )
+                for _rec in c.iter_results("warm", deadline_s=300):
+                    pass
+
+        # ---- placed-verb router tax (backend 1, live unfed job) ----
+        with GellyClient("127.0.0.1", ports[0]) as c:
+            c.submit(
+                name="ovh", query="edges", capacity=capacity,
+                window_edges=win_edges, batch=bs,
+            )
+
+        def rtt_p50(port, reps=200):
+            samples = []
+            with GellyClient("127.0.0.1", port) as c:
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    c.results("ovh", timeout_ms=0)
+                    samples.append(time.perf_counter() - t0)
+            samples.sort()
+            return 1e3 * samples[len(samples) // 2]
+
+        direct_p50 = rtt_p50(ports[0])
+        spec_one = BackendSpec("b1", "127.0.0.1", ports[0])
+        fleet_one = Fleet(
+            FleetConfig(backends=(spec_one,), probe_interval_s=3600.0)
+        )
+        with GLYRouter(fleet_one, RouterConfig()) as router:
+            routed_p50 = rtt_p50(router.port)
+        out["router_overhead_p50_ms"] = round(routed_p50 - direct_p50, 3)
+
+        # ---- aggregate eps over 1/2/4 backends, 4 clients each ----
+        for nb in backends:
+            specs = tuple(
+                BackendSpec(f"b{i + 1}", "127.0.0.1", ports[i])
+                for i in range(nb)
+            )
+            fleet = Fleet(
+                FleetConfig(backends=specs, probe_interval_s=3600.0)
+            )
+            k = nb * clients_per_backend
+            errors = []
+
+            def run_client(i, port):
+                try:
+                    s, d = datasets[i]
+                    name = f"fl{nb}x{i}"
+                    with GellyClient("127.0.0.1", port) as c:
+                        c.submit(
+                            name=name, query="edges", capacity=capacity,
+                            window_edges=win_edges, batch=bs,
+                        )
+                        c.push_edges(
+                            name, s, d, batch=bs, capacity=capacity,
+                            bdv=True,
+                        )
+                        for _rec in c.iter_results(name, deadline_s=600):
+                            pass
+                except BaseException as e:
+                    errors.append(e)
+
+            with GLYRouter(fleet, RouterConfig()) as router:
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=run_client, args=(i, router.port))
+                    for i in range(k)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            out[f"fleet_agg_eps_{nb}"] = round(k * n / wall, 1)
+        out["fleet_scaling_ratio"] = round(
+            out[f"fleet_agg_eps_{max(backends)}"]
+            / max(out[f"fleet_agg_eps_{min(backends)}"], 1e-9),
+            3,
+        )
+
+        # ---- failover: kill -> takeover -> first accepted push ----
+        fdir = os.path.join(td, "fo")
+        fproc, fport = spawn(
+            os.path.join(fdir, "bf"),
+            ("--events-path", os.path.join(fdir, "bf", "journal.jsonl")),
+        )
+        procs.append(fproc)
+        sproc, sport = spawn(
+            os.path.join(fdir, "sb"),
+            ("--events-path", os.path.join(fdir, "sb", "journal.jsonl")),
+        )
+        procs.append(sproc)
+        fo_specs = (
+            BackendSpec(
+                "bf", "127.0.0.1", fport,
+                journal_path=os.path.join(fdir, "bf", "journal.jsonl"),
+                checkpoint_prefix=os.path.join(fdir, "bf", "ck"),
+            ),
+            BackendSpec(
+                "sb", "127.0.0.1", sport,
+                journal_path=os.path.join(fdir, "sb", "journal.jsonl"),
+                checkpoint_prefix=os.path.join(fdir, "sb", "ck"),
+                standby=True,
+            ),
+        )
+        fleet = Fleet(
+            FleetConfig(
+                backends=fo_specs,
+                replica_dir=os.path.join(fdir, "replica"),
+                probe_interval_s=0.05,
+                probe_timeout_s=1.0,
+                fail_threshold=2,
+                replicate_interval_s=3600.0,
+            )
+        )
+        src, dst = datasets[0]
+        half = n // 2
+        with GLYRouter(fleet, RouterConfig()) as router:
+            with GellyClient("127.0.0.1", router.port) as c:
+                c.submit(
+                    name="fo", query="edges", capacity=capacity,
+                    window_edges=win_edges, batch=bs, checkpoint=True,
+                )
+                c.push_edges(
+                    "fo", src[:half], dst[:half], batch=bs,
+                    capacity=capacity, bdv=True, close=False,
+                )
+                # drain every closed window so the checkpoint cursor is
+                # on disk before the kill (half/W edges close half/W - 1
+                # windows: the last needs its boundary-crossing edge)
+                closed = half // win_edges - 1
+                got = 0
+                deadline = time.monotonic() + 120
+                while got < closed and time.monotonic() < deadline:
+                    recs, _state, _eos = c.results("fo", timeout_ms=2000)
+                    got += len(recs)
+                fleet.replicate_once()
+                t_kill = time.perf_counter()
+                fproc.kill()
+                # the resilient push rides rerouted -> reconnect ->
+                # out-of-sync resync onto the standby; it returns at the
+                # first ACCEPTED batch past the resume cursor
+                c.push_edges_resilient(
+                    "fo", src[: half + bs], dst[: half + bs], batch=bs,
+                    capacity=capacity, start=half, close=False,
+                    deadline_s=180.0, backoff_s=0.05,
+                )
+                out["fleet_failover_downtime_ms"] = round(
+                    (time.perf_counter() - t_kill) * 1e3, 1
+                )
+
+        # ---- the 0-recompile guarantee behind the router hop ----
+        with JobManager(RuntimeConfig(max_jobs=8)) as jm, StreamServer(
+            jm, ServerConfig()
+        ) as srv:
+            inproc = Fleet(
+                FleetConfig(
+                    backends=(BackendSpec("inb", "127.0.0.1", srv.port),),
+                    probe_interval_s=3600.0,
+                )
+            )
+            with GLYRouter(inproc, RouterConfig()) as router:
+
+                def one_job(name):
+                    s, d = datasets[1]
+                    with GellyClient("127.0.0.1", router.port) as c:
+                        c.submit(
+                            name=name, query="edges", capacity=capacity,
+                            window_edges=win_edges, batch=bs,
+                        )
+                        c.push_edges(
+                            name, s, d, batch=bs, capacity=capacity,
+                            bdv=True,
+                        )
+                        for _rec in c.iter_results(name, deadline_s=300):
+                            pass
+
+                one_job("rc-warm")
+                rc0 = compile_cache.stats()["recompiles"]
+                one_job("rc-measure")
+                out["fleet_warm_recompiles"] = (
+                    compile_cache.stats()["recompiles"] - rc0
+                )
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                pass
+        shutil.rmtree(td, ignore_errors=True)
+    return out
+
+
 _PARTIAL = {}  # best results so far, emitted by the deadline watchdog
 
 
@@ -1300,6 +1611,12 @@ _HIGHER_KEYS = {
     # no classified suffix (the _eps/_speedup/recompiles keys classify
     # themselves)
     "spmv_parity_ok",
+    # ISSUE 20 fleet tier: the backend-count suffix evades the `_eps`
+    # rule (scaling_ratio/overhead_ms/downtime_ms/recompiles classify
+    # themselves)
+    "fleet_agg_eps_1",
+    "fleet_agg_eps_2",
+    "fleet_agg_eps_4",
 }
 _HIGHER_SUFFIXES = (
     "_eps",
@@ -2222,6 +2539,34 @@ def main():
             )
     except Exception as e:  # never fail the headline metric on the extra one
         print(f"rescale bench skipped: {e}", file=sys.stderr)
+
+    # ---- fleet serving tier: router scaling + warm-standby failover ------
+    # (ISSUE 20 acceptance: aggregate eps monotonic over 1 -> 4 backends,
+    # sub-ms placed-verb router tax, SIGKILL -> standby -> first accepted
+    # push downtime, and 0 recompiles behind the router after warmup)
+    try:
+        if os.environ.get("GELLY_BENCH_FLEET", "1") != "0":
+            fleet_stats = _fleet_bench(
+                windows=int(os.environ.get("GELLY_BENCH_FLEET_WINDOWS", 8)),
+                win_edges=int(
+                    os.environ.get("GELLY_BENCH_FLEET_WIN_EDGES", 1 << 12)
+                ),
+            )
+            _PARTIAL.update(fleet_stats)
+            print(
+                f"fleet: 1/2/4 backends "
+                f"{fleet_stats['fleet_agg_eps_1'] / 1e6:.2f}/"
+                f"{fleet_stats['fleet_agg_eps_2'] / 1e6:.2f}/"
+                f"{fleet_stats['fleet_agg_eps_4'] / 1e6:.2f}M eps aggregate "
+                f"(x{fleet_stats['fleet_scaling_ratio']} at 4), router tax "
+                f"{fleet_stats['router_overhead_p50_ms']} ms p50 on placed "
+                f"verbs, failover {fleet_stats['fleet_failover_downtime_ms']}"
+                f" ms kill->first accepted push, "
+                f"{fleet_stats['fleet_warm_recompiles']} recompiles warm",
+                file=sys.stderr,
+            )
+    except Exception as e:  # never fail the headline metric on the extra one
+        print(f"fleet bench skipped: {e}", file=sys.stderr)
 
     # ---- static-analysis attestation: the artifact doubles as a proof the
     # measured tree passes graftcheck (0 = clean; a positive count means the

@@ -198,7 +198,18 @@ class PushOutOfSync(RuntimeError):
     a mismatch is this typed refusal, and re-pushing from the advertised
     cursor (whose offsets then match) is the recovery — the same
     at-least-once overlap the drain contract already pins.
+
+    ``expected`` / ``declared`` carry the two positions structurally so
+    the serving plane can advertise the cursor IN the refusal (the
+    ``out-of-sync`` reply's ``expected`` field) and a reconnecting client
+    can re-declare its position without a second round trip — the fleet
+    tier's failover resync rides exactly this.
     """
+
+    def __init__(self, message: str, expected: int = None, declared: int = None):
+        super().__init__(message)
+        self.expected = expected
+        self.declared = declared
 
 
 class NetworkEdgeSource:
@@ -436,7 +447,9 @@ class NetworkEdgeSource:
                 f"push declares edge offset {int(offset)} but this source "
                 f"is at {expect} accepted edges (resume filler included): "
                 "the batch belongs to a stream position this source does "
-                "not hold — re-push from the advertised resume cursor"
+                "not hold — re-push from the advertised resume cursor",
+                expected=expect,
+                declared=int(offset),
             )
 
     def _accept(

@@ -29,6 +29,24 @@ The network layer on top (ISSUE 8)::
 
 ``gelly-serve --listen host:port`` runs the long-lived server;
 ``gelly-client`` is the remote console (runtime/client.py).
+
+The fleet tier on top of THAT (ISSUE 20)::
+
+    from gelly_streaming_tpu.runtime import Fleet, GLYRouter
+    from gelly_streaming_tpu.runtime.fleet import BackendSpec, FleetConfig
+
+    fleet = Fleet(FleetConfig(backends=(
+        BackendSpec("b1", "127.0.0.1", 7421),
+        BackendSpec("b2", "127.0.0.1", 7422),
+        BackendSpec("sb", "127.0.0.1", 7429, standby=True),
+    ), replica_dir="/var/lib/gelly/replica"))
+    with GLYRouter(fleet) as router:
+        ...  # GellyClient("127.0.0.1", router.port) — same protocol
+
+``gelly-router --config fleet.json`` is the console form: N
+``gelly-serve`` backends, rendezvous placement per tenant/job,
+journal-replicated warm-standby failover (runtime/fleet.py), and
+verb fan-out aggregation (runtime/router.py).
 """
 
 from gelly_streaming_tpu.core.config import (
@@ -52,11 +70,21 @@ def __getattr__(name):
         from gelly_streaming_tpu.runtime.server import StreamServer
 
         return StreamServer
+    if name == "Fleet":
+        from gelly_streaming_tpu.runtime.fleet import Fleet
+
+        return Fleet
+    if name == "GLYRouter":
+        from gelly_streaming_tpu.runtime.router import GLYRouter
+
+        return GLYRouter
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = [
     "AdmissionError",
+    "Fleet",
+    "GLYRouter",
     "Job",
     "JobError",
     "JobManager",

@@ -34,11 +34,15 @@ class ClientError(RuntimeError):
 
 
 class ServerRefused(RuntimeError):
-    """The server answered with ``ok: false``; carries the typed code."""
+    """The server answered with ``ok: false``; carries the typed code
+    plus the full reply header as ``details`` — resync fields like the
+    ``out-of-sync`` refusal's ``expected`` cursor and the ``rerouted``
+    refusal's ``backend`` live there."""
 
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str, details: Optional[dict] = None):
         super().__init__(message)
         self.code = code
+        self.details = details or {}
 
 
 class GellyClient:
@@ -54,6 +58,9 @@ class GellyClient:
         timeout: Optional[float] = 120.0,
     ):
         self.token = token
+        self._host = host
+        self._port = port
+        self._timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
         try:
             # request/reply framing: Nagle + delayed ACK would add ~40 ms
@@ -72,6 +79,21 @@ class GellyClient:
             self._sock.close()
         except OSError:
             pass
+
+    def reconnect(self) -> None:
+        """Drop the (possibly dead) socket and dial the same address
+        again.  Behind a ``gelly-router`` this re-resolves placement: the
+        router places every frame per-request, so after a failover the
+        same address reaches the standby that took the jobs over."""
+        self.close()
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        try:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._f = self._sock.makefile("rwb")
 
     def __enter__(self) -> "GellyClient":
         return self
@@ -103,7 +125,9 @@ class GellyClient:
         head, pay = self.call_raw(header, payload)
         if not head.get("ok"):
             raise ServerRefused(
-                head.get("code", "error"), head.get("error", "refused")
+                head.get("code", "error"),
+                head.get("error", "refused"),
+                details=head,
             )
         return head, pay
 
@@ -201,7 +225,9 @@ class GellyClient:
             head, _pay = reply
             if not head.get("ok") and refusal is None:
                 refusal = ServerRefused(
-                    head.get("code", "error"), head.get("error", "refused")
+                    head.get("code", "error"),
+                    head.get("error", "refused"),
+                    details=head,
                 )
 
         try:
@@ -241,6 +267,123 @@ class GellyClient:
         if close:
             self.eos(job)
         return len(src)
+
+    # refusal codes that mean "the stream will come back: retry through
+    # the same address" — rerouted (fleet failover in progress), quiesced
+    # (live rescale/drain swapping the source), unavailable
+    _RETRY_CODES = frozenset({"rerouted", "quiesced", "unavailable"})
+
+    def push_edges_resilient(
+        self,
+        job: str,
+        src,
+        dst,
+        batch: int,
+        capacity: int,
+        bdv: bool = False,
+        start: int = 0,
+        close: bool = True,
+        window: int = 32,
+        deadline_s: float = 120.0,
+        backoff_s: float = 0.2,
+    ) -> int:
+        """``push_edges`` with automatic reconnect-with-resync: survives
+        connection loss and typed ``rerouted`` refusals (fleet failover
+        behind a ``gelly-router``) by re-dialing the same address and
+        re-declaring the push position.
+
+        The resync protocol NEVER silently re-pushes acked edges.  The
+        client's cursor only moves when the server tells it to: every
+        frame is offset-stamped, so a frame the server already counted is
+        REFUSED ``out-of-sync`` with the advertised ``expected`` cursor
+        (never folded twice), and the cursor jumps there.  The one case
+        where edges are re-sent is ``expected`` BELOW the cursor — a
+        failover landed the job on a standby whose checkpoint trails the
+        acked stream — and that overlap is server-directed: exactly the
+        suffix past the resume cursor, the same at-least-once/overlap-
+        only contract every restart path in the repo pins.
+
+        Raises the refusal unchanged for non-retryable codes (auth,
+        unknown-job, bad-spec: retrying cannot fix those) and
+        ``ClientError`` when ``deadline_s`` expires first.
+        """
+        total = len(src)
+        pos = int(start)
+        deadline = time.monotonic() + deadline_s
+        last_err: Optional[Exception] = None
+
+        def _wait(transport: bool) -> None:
+            if time.monotonic() > deadline:
+                raise ClientError(
+                    f"resilient push of {job!r} did not finish within "
+                    f"{deadline_s}s (cursor {pos}/{total}): {last_err}"
+                ) from last_err
+            time.sleep(backoff_s)
+            if transport:
+                try:
+                    self.reconnect()
+                except OSError as e:  # router itself briefly down
+                    nonlocal_err(e)
+
+        def nonlocal_err(e: Exception) -> None:
+            nonlocal last_err
+            last_err = e
+
+        while pos < total:
+            try:
+                self.push_edges(
+                    job,
+                    src,
+                    dst,
+                    batch=batch,
+                    capacity=capacity,
+                    bdv=bdv,
+                    start=pos,
+                    close=False,
+                    window=window,
+                )
+                pos = total
+            except ClientError as e:
+                # connection loss mid-window: frames past the last ack
+                # may or may not have landed.  Reconnect and retry from
+                # the stale cursor — counted frames are refused
+                # out-of-sync (not folded) and the refusal's expected
+                # cursor moves us forward.
+                nonlocal_err(e)
+                _wait(transport=True)
+            except ServerRefused as e:
+                expected = e.details.get("expected")
+                if e.code == "out-of-sync" and isinstance(expected, int):
+                    # the server's cursor IS the resync point — jump
+                    # there immediately, no backoff (this is the common
+                    # post-reconnect/post-failover step, not an error)
+                    moved = min(max(expected, 0), total)
+                    nonlocal_err(e)
+                    if moved == pos:
+                        # no progress: something upstream is still
+                        # settling (e.g. a resume filler in flight) —
+                        # don't spin on refusals
+                        _wait(transport=False)
+                    pos = moved
+                elif e.code in self._RETRY_CODES:
+                    nonlocal_err(e)
+                    _wait(transport=False)
+                else:
+                    raise
+        if close:
+            while True:
+                try:
+                    self.eos(job)
+                    break
+                except ClientError as e:
+                    nonlocal_err(e)
+                    _wait(transport=True)
+                except ServerRefused as e:
+                    if e.code not in self._RETRY_CODES:
+                        raise
+                    nonlocal_err(e)
+                    _wait(transport=False)
+        return total - int(start)
 
     def results(
         self, job: str, max_records: int = 256, timeout_ms: int = 1000

@@ -1,0 +1,804 @@
+"""Fleet tier state: backend registry, liveness probing, warm-standby
+replication, journal-replay failover, and tenant rebalancing (ISSUE 20).
+
+One ``gelly-serve`` process is one failure domain bounded by one host's
+cores.  The fleet tier scales past that WITHOUT inventing new machinery:
+
+* :class:`BackendRegistry` — which backends exist and which answer GLY1
+  ``ping`` frames right now (a typed refusal counts as alive: the probe
+  proves the event loop, not the credentials).
+* :class:`Fleet` — consistent placement (rendezvous-hashed on
+  ``tenant/job``, overridden by rebalance pins and failover takeovers),
+  plus warm-standby replication: each backend's JSONL event journal and
+  positional checkpoints (the exact ``per_job_file`` derivation the
+  server already writes) are shipped to the standby's paths, so a
+  SIGKILL'd backend's jobs are resubmittable from journal replay alone —
+  the ``job_spec`` records carry the verbatim client specs, and the
+  replicated checkpoints supply the resume cursors.
+* :class:`FleetRebalancer` — the Autoscaler's policy-thread pattern
+  (streaks, cooldown, deterministic ``evaluate_once`` with an injectable
+  clock, actuation OUTSIDE the lock) generalized from shard geometry to
+  tenant PLACEMENT: sustained PAGE burn on one backend drains the
+  tenant's jobs there (cursors), ships their checkpoints, and resubmits
+  them on a cold backend — the same drain→cursor→resubmit actuation path
+  the elastic control plane (runtime/autoscale.py) already pins.
+
+Everything here is control plane: the data plane (frame relay, offset
+guard, pipelining) lives in runtime/router.py, and the recovery contract
+is the EXISTING one — clients resync through ``out-of-sync``/``expected``
+offsets, at-least-once with overlap-only emissions.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from hashlib import md5
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from gelly_streaming_tpu.runtime import protocol
+from gelly_streaming_tpu.runtime.job import JobState
+from gelly_streaming_tpu.utils import events
+from gelly_streaming_tpu.utils.checkpoint import per_job_file
+
+
+@dataclass(frozen=True)
+class BackendSpec:
+    """One ``gelly-serve --listen`` process the fleet routes to.
+
+    ``journal_path`` / ``checkpoint_prefix`` name the backend's ON-DISK
+    durable state (its ``events_path`` journal and per-job snapshot
+    prefix) as seen from the router's host — replication reads them, so
+    they must be reachable paths (same host or a shared filesystem).
+    ``standby=True`` marks the warm standby: it takes no placements until
+    a failover redirects a dead backend's keys onto it.
+    """
+
+    name: str
+    host: str
+    port: int
+    journal_path: Optional[str] = None
+    checkpoint_prefix: Optional[str] = None
+    standby: bool = False
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Knobs for the fleet control plane.
+
+    Attributes:
+      backends: every process in the fleet, standby included.
+      replica_dir: where backend journal replicas land (one
+        ``journal-<name>.jsonl`` per backend); None disables journal
+        replication (failover then has no specs to replay).
+      tenant_tokens: ``{tenant: token}`` — the control plane's
+        credentials for drain/resubmit during failover and rebalance
+        (open-mode fleets leave it empty and everything runs as the
+        implicit ``default`` tenant).
+      probe_interval_s / probe_timeout_s / fail_threshold: liveness
+        probing cadence; ``fail_threshold`` CONSECUTIVE probe failures
+        transition a backend to down and trigger failover.
+      replicate_interval_s: cadence of the journal/checkpoint shipping
+        loop.
+    """
+
+    backends: Tuple[BackendSpec, ...] = ()
+    replica_dir: Optional[str] = None
+    tenant_tokens: Mapping[str, str] = field(default_factory=dict)
+    probe_interval_s: float = 0.3
+    probe_timeout_s: float = 2.0
+    fail_threshold: int = 2
+    replicate_interval_s: float = 0.5
+
+
+def _probe_backend(spec: BackendSpec, timeout_s: float) -> float:
+    """One liveness probe: connect, ping, read ANY reply -> RTT ms.
+
+    A typed refusal (e.g. ``auth`` on a token-mode backend) still proves
+    the process accepts connections and serves frames — liveness, not
+    authorization, is what the registry tracks.
+    """
+    t0 = time.perf_counter()
+    with socket.create_connection(
+        (spec.host, spec.port), timeout=timeout_s
+    ) as sock:
+        sock.settimeout(timeout_s)
+        f = sock.makefile("rwb")
+        protocol.write_frame(f, {"verb": "ping", "token": ""})
+        if protocol.read_frame(f) is None:
+            raise OSError("backend closed the probe connection")
+    return (time.perf_counter() - t0) * 1e3
+
+
+class BackendRegistry:
+    """Live/down state for every backend, maintained by a probe thread.
+
+    ``report_failure`` lets the data plane (a relay whose upstream write
+    failed) feed the same counter the probes use, so a dead backend is
+    detected at frame latency, not probe latency; the down transition —
+    and its ``on_down`` callback — still fires exactly once.
+    """
+
+    def __init__(
+        self,
+        backends: Tuple[BackendSpec, ...],
+        probe_interval_s: float = 0.3,
+        probe_timeout_s: float = 2.0,
+        fail_threshold: int = 2,
+        on_down: Optional[Callable[[BackendSpec], None]] = None,
+    ):
+        self.backends = tuple(backends)
+        self._by_name = {b.name: b for b in self.backends}
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.fail_threshold = max(1, int(fail_threshold))
+        self._on_down = on_down
+        self._lock = threading.Lock()
+        self._alive = {b.name: True for b in self.backends}  # guarded-by: _lock
+        self._fails = {b.name: 0 for b in self.backends}  # guarded-by: _lock
+        self._rtt_ms = {b.name: None for b in self.backends}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def backend(self, name: str) -> Optional[BackendSpec]:
+        return self._by_name.get(name)
+
+    def is_alive(self, name: str) -> bool:
+        with self._lock:
+            return bool(self._alive.get(name))
+
+    def mark_up(self, name: str) -> None:
+        with self._lock:
+            self._alive[name] = True
+            self._fails[name] = 0
+
+    def report_failure(self, name: str) -> None:
+        """One observed failure against ``name`` (probe or data plane);
+        the ``fail_threshold``-th consecutive one transitions it down."""
+        if name not in self._by_name:
+            return
+        newly_down = False
+        with self._lock:
+            self._fails[name] = self._fails.get(name, 0) + 1
+            if self._alive.get(name) and (
+                self._fails[name] >= self.fail_threshold
+            ):
+                self._alive[name] = False
+                newly_down = True
+        # the callback does real work (failover submits) — never under
+        # the registry lock, and never twice for one down transition
+        if newly_down and self._on_down is not None:
+            self._on_down(self._by_name[name])
+
+    def probe_once(self) -> Dict[str, bool]:
+        """Probe every backend once; returns ``{name: alive}``."""
+        for spec in self.backends:
+            try:
+                rtt = _probe_backend(spec, self.probe_timeout_s)
+            except (OSError, protocol.ProtocolError):
+                self.report_failure(spec.name)
+                continue
+            with self._lock:
+                self._alive[spec.name] = True
+                self._fails[spec.name] = 0
+                self._rtt_ms[spec.name] = round(rtt, 3)
+        with self._lock:
+            return dict(self._alive)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-backend registry rows for the router's ``fleet`` verb."""
+        with self._lock:
+            alive = dict(self._alive)
+            fails = dict(self._fails)
+            rtt = dict(self._rtt_ms)
+        return {
+            b.name: {
+                "host": b.host,
+                "port": b.port,
+                "standby": b.standby,
+                "alive": bool(alive.get(b.name)),
+                "fails": fails.get(b.name, 0),
+                "rtt_ms": rtt.get(b.name),
+            }
+            for b in self.backends
+        }
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-probe", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # a probe bug must never kill the thread
+                continue
+
+
+class Fleet:
+    """Placement + replication + failover for one router's backends.
+
+    Placement resolves in three layers, most specific first: rebalance
+    PINS (``tenant/job`` moved explicitly), failover TAKEOVERS (every key
+    of a dead backend redirected to the standby), then rendezvous hashing
+    over the serving (non-standby) backends — deterministic, so N
+    stateless routers over the same config agree without coordination.
+    """
+
+    def __init__(self, cfg: FleetConfig):
+        self.cfg = cfg
+        self.serving = tuple(b for b in cfg.backends if not b.standby)
+        standbys = [b for b in cfg.backends if b.standby]
+        self.standby = standbys[0] if standbys else None
+        if not self.serving:
+            raise ValueError("fleet needs at least one serving backend")
+        self.registry = BackendRegistry(
+            cfg.backends,
+            probe_interval_s=cfg.probe_interval_s,
+            probe_timeout_s=cfg.probe_timeout_s,
+            fail_threshold=cfg.fail_threshold,
+            on_down=self._backend_down,
+        )
+        # token -> tenant (placement is keyed on the TENANT, and the
+        # token is its wire proxy); unknown tokens hash as themselves so
+        # placement stays consistent even without a configured table
+        self._tenant_of = {t: name for name, t in cfg.tenant_tokens.items()}
+        self._lock = threading.Lock()
+        self._pins: Dict[str, str] = {}  # guarded-by: _lock
+        self._takeover: Dict[str, str] = {}  # guarded-by: _lock
+        self._failed_over: set = set()  # guarded-by: _lock
+        self._repl_stats = {"files": 0, "bytes": 0, "syncs": 0}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._repl_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.registry.start()
+        if self.cfg.replica_dir and self._repl_thread is None:
+            os.makedirs(self.cfg.replica_dir, exist_ok=True)
+            self._stop.clear()
+            self._repl_thread = threading.Thread(
+                target=self._replicate_run, name="fleet-replicate", daemon=True
+            )
+            self._repl_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.registry.stop()
+        t = self._repl_thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._repl_thread = None
+
+    def __enter__(self) -> "Fleet":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- placement -----------------------------------------------------------
+
+    def tenant_for_token(self, token: str) -> str:
+        return self._tenant_of.get(token) or (token or "default")
+
+    def _rendezvous(self, key: str) -> str:
+        """Highest-random-weight choice over the serving backends: each
+        key independently lands on the backend whose ``md5(name|key)``
+        wins, so placement is uniform, deterministic, and stable under
+        the FIXED backend set (liveness changes reroute via takeovers,
+        never by re-hashing every key)."""
+        return max(
+            self.serving,
+            key=lambda b: md5(f"{b.name}|{key}".encode()).digest(),
+        ).name
+
+    def place(self, tenant: str, job: str) -> BackendSpec:
+        """Resolve ``tenant/job`` to its backend: pin, then takeover
+        redirect, then rendezvous."""
+        key = f"{tenant}/{job}"
+        with self._lock:
+            name = self._pins.get(key)
+            takeover = dict(self._takeover)
+        if name is None:
+            name = self._rendezvous(key)
+        name = takeover.get(name, name)
+        return self.registry.backend(name) or self.serving[0]
+
+    def pin(self, tenant: str, job: str, backend: str) -> None:
+        with self._lock:
+            self._pins[f"{tenant}/{job}"] = backend
+
+    def pin_counts(self) -> Dict[str, int]:
+        counts = {b.name: 0 for b in self.serving}
+        with self._lock:
+            pins = dict(self._pins)
+        for name in pins.values():
+            counts[name] = counts.get(name, 0) + 1
+        return counts
+
+    def takeover_map(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._takeover)
+
+    def snapshot(self) -> dict:
+        """The ``fleet`` verb's payload: registry rows + routing state."""
+        with self._lock:
+            pins = dict(self._pins)
+            takeover = dict(self._takeover)
+            repl = dict(self._repl_stats)
+        return {
+            "backends": self.registry.snapshot(),
+            "standby": self.standby.name if self.standby else None,
+            "takeover": takeover,
+            "pins": pins,
+            "replication": repl,
+        }
+
+    # -- warm-standby replication --------------------------------------------
+
+    def replica_journal_path(self, name: str) -> Optional[str]:
+        if not self.cfg.replica_dir:
+            return None
+        return os.path.join(self.cfg.replica_dir, f"journal-{name}.jsonl")
+
+    @staticmethod
+    def _copy_if_changed(src: str, dst: str) -> int:
+        """tmp+rename copy (the destination is always a COMPLETE older
+        snapshot, never a torn one); skipped when size+mtime already
+        match.  Returns bytes shipped."""
+        try:
+            st = os.stat(src)
+        except OSError:
+            return 0
+        try:
+            dt = os.stat(dst)
+            if (dt.st_size, dt.st_mtime_ns) == (st.st_size, st.st_mtime_ns):
+                return 0
+        except OSError:
+            pass
+        os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
+        tmp = dst + ".tmp"
+        shutil.copy2(src, tmp)
+        os.replace(tmp, dst)
+        return st.st_size
+
+    def sync_backend(
+        self,
+        spec: BackendSpec,
+        ckpt_dst_prefix: Optional[str] = None,
+        jobs: Optional[List[str]] = None,
+    ) -> Dict[str, int]:
+        """Ship one backend's durable state: its event journal to the
+        replica dir, and its positional checkpoints to the standby's
+        checkpoint prefix (or ``ckpt_dst_prefix`` — the rebalance target).
+
+        ``jobs`` restricts the checkpoint copy to those job ids (the
+        server's ``tenant.name`` keying) — rebalance moves ONE tenant's
+        files, not the whole backend's.
+        """
+        stats = {"files": 0, "bytes": 0}
+        replica = self.replica_journal_path(spec.name)
+        if spec.journal_path and replica:
+            n = self._copy_if_changed(spec.journal_path, replica)
+            if n:
+                stats["files"] += 1
+                stats["bytes"] += n
+        dst_prefix = ckpt_dst_prefix
+        if dst_prefix is None and self.standby is not None:
+            dst_prefix = self.standby.checkpoint_prefix
+        src_prefix = spec.checkpoint_prefix
+        if src_prefix and dst_prefix and dst_prefix != src_prefix:
+            if jobs is not None:
+                paths = [per_job_file(src_prefix, j) for j in jobs]
+            else:
+                base = (
+                    src_prefix[: -len(".npz")]
+                    if src_prefix.endswith(".npz")
+                    else src_prefix
+                )
+                paths = glob.glob(glob.escape(base) + ".job_*.npz")
+            dst_base = (
+                dst_prefix[: -len(".npz")]
+                if dst_prefix.endswith(".npz")
+                else dst_prefix
+            )
+            src_base = (
+                src_prefix[: -len(".npz")]
+                if src_prefix.endswith(".npz")
+                else src_prefix
+            )
+            for path in paths:
+                n = self._copy_if_changed(
+                    path, dst_base + path[len(src_base):]
+                )
+                if n:
+                    stats["files"] += 1
+                    stats["bytes"] += n
+        with self._lock:
+            self._repl_stats["files"] += stats["files"]
+            self._repl_stats["bytes"] += stats["bytes"]
+            self._repl_stats["syncs"] += 1
+        return stats
+
+    def replicate_once(self) -> Dict[str, int]:
+        total = {"files": 0, "bytes": 0}
+        for spec in self.serving:
+            try:
+                stats = self.sync_backend(spec)
+            except OSError:
+                continue  # a torn source retries next tick
+            total["files"] += stats["files"]
+            total["bytes"] += stats["bytes"]
+        return total
+
+    def _replicate_run(self) -> None:
+        while not self._stop.wait(self.cfg.replicate_interval_s):
+            try:
+                self.replicate_once()
+            except Exception:  # replication must never kill its thread
+                continue
+
+    # -- failover ------------------------------------------------------------
+
+    def _backend_down(self, spec: BackendSpec) -> None:
+        """Registry down-transition hook.  Failover does network work
+        (resubmits against the standby), so it runs on its own thread —
+        the caller may be a relay's reader mid-frame."""
+        events.journal().emit(
+            "fleet_backend_down", backend=spec.name, standby=spec.standby
+        )
+        if spec.standby or self.standby is None:
+            return
+        threading.Thread(
+            target=self.failover,
+            args=(spec.name,),
+            name=f"fleet-failover-{spec.name}",
+            daemon=True,
+        ).start()
+
+    def failover(self, name: str) -> dict:
+        """Reattach a dead backend's live jobs on the warm standby.
+
+        Replays the backend's journal REPLICA (a final sync first — the
+        dead process's files are still on disk), resubmits every
+        non-terminal ``job_spec`` verbatim against the standby (whose
+        replicated checkpoints supply the resume cursors), then installs
+        the takeover redirect so placement — and every reconnecting
+        client — lands on the standby.  Runs at most once per backend.
+        """
+        spec = self.registry.backend(name)
+        if spec is None or self.standby is None:
+            return {"backend": name, "resubmitted": [], "failed": []}
+        with self._lock:
+            # check-and-claim under ONE lock hold: two down-reports race
+            # here, exactly one runs the failover
+            if name in self._failed_over:
+                return {"backend": name, "resubmitted": [], "failed": []}
+            self._failed_over.add(name)
+        try:
+            self.sync_backend(spec)
+        except OSError:
+            pass  # the periodic replica (if any) is the fallback
+        replica = self.replica_journal_path(name)
+        evs: List[dict] = []
+        if replica and os.path.exists(replica):
+            evs = events.replay(replica)
+        specs: Dict[str, dict] = {}
+        for ev in evs:
+            if ev.get("kind") == "job_spec":
+                specs[ev["job"]] = ev
+        from gelly_streaming_tpu.runtime.client import (
+            ClientError,
+            GellyClient,
+            ServerRefused,
+        )
+
+        resubmitted, failed = [], []
+        for job_key, ev in sorted(specs.items()):
+            try:
+                hist = events.job_history(evs, job_key)
+            except ValueError:
+                hist = []  # a gapped chain still resubmits: liveness wins
+            if hist and hist[-1] and hist[-1][-1] in JobState.TERMINAL:
+                continue  # completed before the crash: nothing to reattach
+            tenant = ev.get("tenant", "default")
+            token = self.cfg.tenant_tokens.get(tenant, "")
+            try:
+                with GellyClient(
+                    self.standby.host, self.standby.port, token=token
+                ) as client:
+                    reply = client.submit(**ev.get("spec", {}))
+                resubmitted.append(
+                    {
+                        "job": job_key,
+                        "resume_edges": reply.get("resume_edges", 0),
+                    }
+                )
+            except (OSError, ClientError, ServerRefused) as e:
+                failed.append({"job": job_key, "error": str(e)})
+        with self._lock:
+            self._takeover[name] = self.standby.name
+        events.journal().emit(
+            "fleet_failover",
+            backend=name,
+            standby=self.standby.name,
+            jobs=[r["job"] for r in resubmitted],
+            failed=[f["job"] for f in failed],
+        )
+        return {
+            "backend": name,
+            "standby": self.standby.name,
+            "resubmitted": resubmitted,
+            "failed": failed,
+        }
+
+    # -- rebalance -----------------------------------------------------------
+
+    def rebalance(self, tenant: str, src_name: str, dst_name: str) -> dict:
+        """Move one tenant's jobs from ``src`` to ``dst``: drain (resume
+        cursors), ship their checkpoints + the journal, resubmit the
+        journaled specs on ``dst``, pin the keys there.  The jobs'
+        clients ride the EXISTING recovery contract the whole way:
+        ``quiesced`` refusals during the drain, then ``out-of-sync`` with
+        the advertised cursor once the pins route them to ``dst``.
+        """
+        src = self.registry.backend(src_name)
+        dst = self.registry.backend(dst_name)
+        if src is None or dst is None:
+            raise ValueError(f"unknown backend {src_name!r}/{dst_name!r}")
+        token = self.cfg.tenant_tokens.get(tenant, "")
+        from gelly_streaming_tpu.runtime.client import GellyClient
+
+        with GellyClient(src.host, src.port, token=token) as client:
+            cursors = client.drain().get("cursors", {})
+        if not cursors:
+            return {"tenant": tenant, "moved": [], "failed": []}
+        self.sync_backend(
+            src,
+            ckpt_dst_prefix=dst.checkpoint_prefix,
+            jobs=[f"{tenant}.{n}" for n in cursors],
+        )
+        replica = self.replica_journal_path(src_name)
+        evs = (
+            events.replay(replica)
+            if replica and os.path.exists(replica)
+            else []
+        )
+        specs = {
+            ev["job"]: ev for ev in evs if ev.get("kind") == "job_spec"
+        }
+        moved, failed = [], []
+        for jname, cur in sorted(cursors.items()):
+            job_key = f"{tenant}/{jname}"
+            ev = specs.get(job_key)
+            if ev is None:
+                failed.append({"job": job_key, "error": "no journaled spec"})
+                continue
+            try:
+                with GellyClient(dst.host, dst.port, token=token) as client:
+                    reply = client.submit(**ev.get("spec", {}))
+            except Exception as e:
+                failed.append({"job": job_key, "error": str(e)})
+                continue
+            self.pin(tenant, jname, dst_name)
+            moved.append(
+                {
+                    "job": job_key,
+                    "cursor": cur.get("resume_edges"),
+                    "resume_edges": reply.get("resume_edges", 0),
+                }
+            )
+        events.journal().emit(
+            "fleet_rebalance",
+            tenant=tenant,
+            source=src_name,
+            target=dst_name,
+            jobs=[m["job"] for m in moved],
+            failed=[f["job"] for f in failed],
+        )
+        return {"tenant": tenant, "moved": moved, "failed": failed}
+
+
+@dataclass(frozen=True)
+class RebalancePolicy:
+    """Knobs for the fleet rebalancer's policy loop.
+
+    ``page_streak`` CONSECUTIVE evaluations observing PAGE-level burn for
+    one (backend, tenant) trigger a move; ``cooldown_s`` then holds that
+    pair — rebalancing is a big hammer, and flapping placement under a
+    sustained overload would multiply the pain, not divide it.
+    """
+
+    interval_s: float = 2.0
+    page_streak: int = 3
+    cooldown_s: float = 60.0
+    probe_timeout_s: float = 5.0
+
+
+class FleetRebalancer:
+    """Fleet-aware elasticity: sustained PAGE burn on one backend moves
+    the burning tenant's jobs to a cold one.
+
+    The Autoscaler's shape exactly (runtime/autoscale.py): a policy
+    thread with an injectable clock, a deterministic ``evaluate_once``
+    that tests drive directly, streak/cooldown state under one lock, and
+    actuation OUTSIDE the lock.  ``burn_probe(spec) -> {tenant: bool}``
+    is injectable too — the default reads each backend's ``alerts`` verb
+    and reports tenants with a PAGE-state row.
+    """
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        policy: Optional[RebalancePolicy] = None,
+        burn_probe: Optional[
+            Callable[[BackendSpec], Mapping[str, bool]]
+        ] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.fleet = fleet
+        self.policy = policy or RebalancePolicy()
+        self._burn_probe = burn_probe or self._probe_alerts
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._streaks: Dict[Tuple[str, str], int] = {}  # guarded-by: _lock
+        self._last_move: Dict[Tuple[str, str], float] = {}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _probe_alerts(self, spec: BackendSpec) -> Mapping[str, bool]:
+        """Default burn probe: one ``alerts`` call per configured tenant;
+        a PAGE-state row attributes to the row's job scope's tenant."""
+        from gelly_streaming_tpu.runtime.client import GellyClient
+
+        tokens = dict(self.fleet.cfg.tenant_tokens) or {"default": ""}
+        out: Dict[str, bool] = {}
+        for tenant, token in tokens.items():
+            try:
+                with GellyClient(
+                    spec.host,
+                    spec.port,
+                    token=token,
+                    timeout=self.policy.probe_timeout_s,
+                ) as client:
+                    rows = client.alerts()
+            except Exception:
+                continue  # an unreachable backend is the registry's call
+            for row in rows:
+                if row.get("state") != "PAGE":
+                    continue
+                scope = str(row.get("id", row.get("scope", "")))
+                owner = scope.split("/", 1)[0] if "/" in scope else tenant
+                out[owner] = True
+        return out
+
+    def evaluate_once(self, now: float) -> List[dict]:
+        """One deterministic policy evaluation at time ``now``; returns
+        the rebalance outcomes it actuated (possibly empty)."""
+        observations = []
+        for spec in self.fleet.serving:
+            if not self.fleet.registry.is_alive(spec.name):
+                continue
+            burn = self._burn_probe(spec)  # network I/O: outside the lock
+            observations.append((spec, dict(burn)))
+        decisions: List[Tuple[str, str]] = []
+        with self._lock:
+            for spec, burn in observations:
+                burning_now = {t for t, b in burn.items() if b}
+                # a tenant ABSENT from this probe is not burning: its
+                # streak resets (the default probe only reports PAGE
+                # rows, so absence is the all-clear signal — a stale
+                # streak must not combine with one later PAGE into an
+                # instant move)
+                for key in list(self._streaks):
+                    if key[0] == spec.name and key[1] not in burning_now:
+                        self._streaks[key] = 0
+                for tenant in sorted(burning_now):
+                    key = (spec.name, tenant)
+                    self._streaks[key] = self._streaks.get(key, 0) + 1
+                    last = self._last_move.get(key)
+                    cooled = (
+                        last is None
+                        or now - last >= self.policy.cooldown_s
+                    )
+                    if self._streaks[key] >= self.policy.page_streak and (
+                        cooled
+                    ):
+                        decisions.append(key)
+                        self._streaks[key] = 0
+                        self._last_move[key] = now
+        results = []
+        for src_name, tenant in decisions:  # actuation: outside the lock
+            dst_name = self._pick_target(src_name)
+            if dst_name is None:
+                events.journal().emit(
+                    "rebalance_failed",
+                    tenant=tenant,
+                    source=src_name,
+                    error="no live target backend",
+                )
+                continue
+            events.journal().emit(
+                "rebalance_decision",
+                tenant=tenant,
+                source=src_name,
+                target=dst_name,
+            )
+            try:
+                outcome = self.fleet.rebalance(tenant, src_name, dst_name)
+            except Exception as e:
+                events.journal().emit(
+                    "rebalance_failed",
+                    tenant=tenant,
+                    source=src_name,
+                    target=dst_name,
+                    error=str(e),
+                )
+                continue
+            events.journal().emit(
+                "rebalance_done",
+                tenant=tenant,
+                source=src_name,
+                target=dst_name,
+                jobs=[m["job"] for m in outcome["moved"]],
+            )
+            results.append(outcome)
+        return results
+
+    def _pick_target(self, src_name: str) -> Optional[str]:
+        """The coldest live serving backend that isn't the source: fewest
+        pinned keys, name as the deterministic tiebreak."""
+        takeover = self.fleet.takeover_map()
+        counts = self.fleet.pin_counts()
+        candidates = [
+            b.name
+            for b in self.fleet.serving
+            if b.name != src_name
+            and b.name not in takeover
+            and self.fleet.registry.is_alive(b.name)
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda n: (counts.get(n, 0), n))
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-rebalance", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.policy.interval_s):
+            try:
+                self.evaluate_once(self._clock())
+            except Exception:  # policy bugs must never kill the thread
+                continue

@@ -16,6 +16,13 @@ Frame grammar (all integers big-endian)::
 
 Requests carry ``{"verb": ..., "token": ..., ...}``; replies carry
 ``{"ok": true/false, ...}`` with ``error`` and ``code`` on refusals.
+Refusal codes are part of the wire contract — clients branch on them:
+``out-of-sync`` carries the ``expected`` resync cursor (the positional
+offset guard), ``quiesced`` means a live rescale/drain is swapping the
+source, and ``rerouted`` (emitted by the fleet tier's ``gelly-router``,
+runtime/router.py) names the ``backend`` that went away — reconnect
+through the same address and resume from the last acked offset
+(``GellyClient.push_edges_resilient``).
 
 Robustness is by construction, not by handler discipline: the reader
 refuses bad magic, oversized headers/payloads, truncated streams, and

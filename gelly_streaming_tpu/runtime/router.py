@@ -1,0 +1,716 @@
+"""``gelly-router``: a thin stateless GLY1 router over N ``gelly-serve``
+backends (ISSUE 20).
+
+The fleet tier's data plane: clients speak the SAME frame protocol
+(runtime/protocol.py) to the router they would speak to one backend, and
+the router places every job-scoped frame on its backend — rendezvous
+placement keyed on ``tenant/job`` (runtime/fleet.py), overridden by
+rebalance pins and failover takeovers — and relays the replies back IN
+ORDER.  Nothing about the serving contract changes at this hop:
+
+* PIPELINING is preserved.  The relay forwards each frame as it arrives
+  (no round-trip wait) and a reply pump writes replies back in request
+  order, so ``GellyClient.push_edges``'s bounded window sees the same
+  in-order reply stream a direct connection gives — across backends.
+* The positional OFFSET GUARD travels untouched: frames are forwarded
+  verbatim, so the backend's source verifies the same global offsets and
+  refuses ``out-of-sync`` with the same advertised ``expected`` cursor.
+* FAILURES are typed, never silent: a frame bound for a dead backend is
+  answered ``rerouted`` (plus the failure feeds the fleet registry, so
+  detection runs at frame latency), and the client's reconnect-with-
+  resync path (``GellyClient.push_edges_resilient``) retries through the
+  router until the standby takeover routes it — at-least-once with
+  overlap-only emissions, the existing drain/restart contract.
+
+Fan-out verbs (``status``/``metrics``/``health``/``alerts``/``events``/
+``trace``/``drain``) are answered BY the router: one call per live
+backend with the client's own token (tenant scoping is the backend's
+job), merged under a ``backends`` section plus a best-effort union of the
+per-job rows.  The router-only ``fleet`` verb exposes the registry,
+takeover, pin, and replication state — ``gelly-top --fleet`` renders it.
+
+The router holds NO job state: placement is a pure function of the
+config plus the (journal-replicated) failover/rebalance overrides, so a
+router restart — or a second router over the same config — changes
+nothing about where frames land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import socket
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from gelly_streaming_tpu.runtime import protocol
+from gelly_streaming_tpu.runtime.fleet import (
+    BackendSpec,
+    Fleet,
+    FleetConfig,
+    FleetRebalancer,
+    RebalancePolicy,
+)
+
+# verbs resolved by placement and relayed to ONE backend
+_PLACED_VERBS = (
+    "submit",
+    "push",
+    "eos",
+    "results",
+    "pause",
+    "resume",
+    "cancel",
+)
+# verbs answered by the router via one call per live backend
+_FANOUT_VERBS = ("status", "metrics", "health", "alerts", "events", "trace")
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Knobs for the router's listener and relay sockets."""
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    max_frame_bytes: int = protocol.DEFAULT_MAX_PAYLOAD
+    connect_timeout_s: float = 5.0
+    upstream_timeout_s: float = 120.0
+    fanout_timeout_s: float = 10.0
+
+
+class _Upstream:
+    """One relay's connection to one backend.  Created by the reader
+    thread; the reply pump reads from it; ``dead`` (an Event, so both
+    threads see it without a lock) retires it after any failure."""
+
+    __slots__ = ("name", "sock", "f", "dead")
+
+    def __init__(self, name: str, sock: socket.socket):
+        self.name = name
+        self.sock = sock
+        self.f = sock.makefile("rwb")
+        self.dead = threading.Event()
+
+    def close(self) -> None:
+        self.dead.set()
+        try:
+            self.f.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _rerouted(name: str) -> dict:
+    return protocol.error_reply(
+        f"backend {name!r} is unavailable — the fleet is rerouting its "
+        "jobs; reconnect/retry and resync from the advertised cursor",
+        code="rerouted",
+        backend=name,
+    )
+
+
+class _Relay:
+    """One client connection: a reader thread that forwards frames as
+    they arrive, and a reply pump that writes replies back in order.
+
+    The expectation queue is the ordering contract: the reader enqueues
+    one entry per request frame — ``("remote", upstream)`` for relayed
+    frames, ``("local", head, payload, after)`` for router-answered ones
+    — and the pump resolves them strictly in order (each backend answers
+    its own frames in order, so popping expectations in request order
+    yields the client's in-order reply stream even when consecutive
+    frames landed on different backends)."""
+
+    def __init__(self, router: "GLYRouter", sock: socket.socket):
+        self._router = router
+        self._sock = sock
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._f = sock.makefile("rwb")
+        self._reader = protocol.FrameReader(
+            self._f, router.cfg.max_frame_bytes
+        )
+        self._expect: "queue.Queue" = queue.Queue()
+        self._ups: Dict[str, _Upstream] = {}  # reader-thread-only state
+
+    # -- reader side ---------------------------------------------------------
+
+    def run(self) -> None:
+        pump = threading.Thread(
+            target=self._pump, name="gly-router-pump", daemon=True
+        )
+        pump.start()
+        try:
+            self._read_loop()
+        finally:
+            self._expect.put(("eof",))
+            pump.join(timeout=self._router.cfg.upstream_timeout_s)
+            self.close()
+
+    def _read_loop(self) -> None:
+        while not self._router._stop.is_set():
+            try:
+                frame = self._reader.read()
+            except protocol.ProtocolError as e:
+                code = (
+                    "frame-too-large"
+                    if isinstance(e, protocol.FrameTooLarge)
+                    else "bad-frame"
+                )
+                self._local(protocol.error_reply(str(e), code=code))
+                return  # the stream cannot be resynced: reply and close
+            except OSError:
+                return
+            if frame is None:
+                return
+            header, payload = frame
+            try:
+                self._route(header, payload)
+            except Exception as e:  # a router bug must not kill the socket
+                self._local(
+                    protocol.error_reply(
+                        f"{type(e).__name__}: {e}", code="internal"
+                    )
+                )
+
+    def _local(self, head: dict, payload: bytes = b"", after=None) -> None:
+        self._expect.put(("local", head, payload, after))
+
+    def _route(self, header: dict, payload) -> None:
+        verb = header.get("verb")
+        router = self._router
+        if verb == "ping":
+            self._local(
+                {
+                    "ok": True,
+                    "router": True,
+                    "backends": len(router.fleet.cfg.backends),
+                }
+            )
+            return
+        if verb == "fleet":
+            self._local(router._fleet_reply(header))
+            return
+        if verb in _FANOUT_VERBS or verb == "drain":
+            head, body = router._fanout(verb, header)
+            # a fleet-wide `drain {shutdown: true}` stops every backend;
+            # the router must not outlive the fleet it fronts
+            after = (
+                router._shutdown
+                if verb == "drain" and header.get("shutdown")
+                else None
+            )
+            self._local(head, body, after)
+            return
+        if verb == "shutdown":
+            router._fanout("shutdown", header)
+            self._local({"ok": True, "fleet": True}, b"", router._shutdown)
+            return
+        if verb not in _PLACED_VERBS:
+            self._local(
+                protocol.error_reply(
+                    f"unknown verb {verb!r} (router speaks "
+                    f"{'/'.join(_PLACED_VERBS + _FANOUT_VERBS)}"
+                    "/ping/fleet/drain/shutdown)",
+                    code="unknown-verb",
+                )
+            )
+            return
+        if verb == "submit":
+            spec = header.get("spec")
+            job = spec.get("name") if isinstance(spec, dict) else None
+        else:
+            job = header.get("job")
+        if not isinstance(job, str) or not job:
+            self._local(
+                protocol.error_reply(
+                    "missing job name: placement needs 'job' (or a submit "
+                    "'spec' with a non-empty 'name')",
+                    code="bad-spec",
+                )
+            )
+            return
+        tenant = router.fleet.tenant_for_token(header.get("token", ""))
+        spec_b = router.fleet.place(tenant, job)
+        self._forward(spec_b, header, payload)
+
+    def _forward(self, spec: BackendSpec, header: dict, payload) -> None:
+        up = self._upstream_for(spec)
+        if up is None:
+            self._local(_rerouted(spec.name))
+            return
+        try:
+            protocol.write_frame(up.f, header, payload)
+        except (OSError, protocol.ProtocolError):
+            up.dead.set()
+            self._router.fleet.registry.report_failure(spec.name)
+            self._local(_rerouted(spec.name))
+            return
+        self._expect.put(("remote", up))
+
+    def _upstream_for(self, spec: BackendSpec) -> Optional[_Upstream]:
+        up = self._ups.get(spec.name)
+        if up is not None and not up.dead.is_set():
+            return up
+        if up is not None:
+            up.close()
+        if not self._router.fleet.registry.is_alive(spec.name):
+            return None  # known-dead: refuse at frame latency, no connect
+        cfg = self._router.cfg
+        try:
+            sock = socket.create_connection(
+                (spec.host, spec.port), timeout=cfg.connect_timeout_s
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(cfg.upstream_timeout_s)
+        except OSError:
+            self._router.fleet.registry.report_failure(spec.name)
+            return None
+        up = _Upstream(spec.name, sock)
+        self._ups[spec.name] = up
+        return up
+
+    # -- pump side -----------------------------------------------------------
+
+    def _pump(self) -> None:
+        """Resolve expectations in order; the ONE writer to the client
+        socket."""
+        while True:
+            item = self._expect.get()
+            kind = item[0]
+            if kind == "eof":
+                return
+            after = None
+            if kind == "local":
+                _k, head, payload, after = item
+            else:
+                up = item[1]
+                head, payload = self._reply_from(up)
+            try:
+                protocol.write_frame(self._f, head, payload)
+            except (OSError, protocol.ProtocolError):
+                return  # client gone: the reader will notice and wind down
+            if after is not None:
+                after()
+
+    def _reply_from(self, up: _Upstream) -> Tuple[dict, bytes]:
+        if up.dead.is_set():
+            return _rerouted(up.name), b""
+        try:
+            reply = protocol.read_frame(
+                up.f, self._router.cfg.max_frame_bytes
+            )
+        except (OSError, protocol.ProtocolError):
+            reply = None
+        if reply is None:
+            # mid-call connection loss: every later expectation on this
+            # upstream answers rerouted too, and the registry hears about
+            # it once — failover detection at frame latency
+            if not up.dead.is_set():
+                up.dead.set()
+                self._router.fleet.registry.report_failure(up.name)
+            return _rerouted(up.name), b""
+        return reply
+
+    def close(self) -> None:
+        for up in self._ups.values():
+            up.close()
+        self._ups.clear()
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class GLYRouter:
+    """The fleet listener: accepts GLY1 clients and relays per-frame.
+
+    ``start()`` also starts the fleet's control plane (probe +
+    replication threads) and, when configured, the rebalancer."""
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        cfg: Optional[RouterConfig] = None,
+        rebalancer: Optional[FleetRebalancer] = None,
+    ):
+        self.fleet = fleet
+        self.cfg = cfg or RouterConfig()
+        self.rebalancer = rebalancer
+        self.port: Optional[int] = None
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._down = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._relays: set = set()  # guarded-by: _lock
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "GLYRouter":
+        self.fleet.start()
+        if self.rebalancer is not None:
+            self.rebalancer.start()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.cfg.host, self.cfg.port))
+        sock.listen(128)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_run, name="gly-router-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        t = self._accept_thread
+        if t is not None:
+            t.join(timeout=5.0)
+        with self._lock:
+            relays = list(self._relays)
+        for relay in relays:
+            relay.close()
+        if self.rebalancer is not None:
+            self.rebalancer.stop()
+        self.fleet.stop()
+        self._down.set()
+
+    def _shutdown(self) -> None:
+        """Post-reply shutdown hook (the ``shutdown`` verb): the stop
+        runs on its own thread so the relay's pump — which called us
+        right after writing the acknowledgement — is never joined from
+        inside itself."""
+        threading.Thread(
+            target=self.stop, name="gly-router-stop", daemon=True
+        ).start()
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._down.wait(timeout)
+
+    def __enter__(self) -> "GLYRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            relay = _Relay(self, conn)
+            with self._lock:
+                self._relays.add(relay)
+            threading.Thread(
+                target=self._relay_run,
+                args=(relay,),
+                name="gly-router-relay",
+                daemon=True,
+            ).start()
+
+    def _relay_run(self, relay: _Relay) -> None:
+        try:
+            relay.run()
+        finally:
+            with self._lock:
+                self._relays.discard(relay)
+
+    # -- router-answered verbs ----------------------------------------------
+
+    def _fleet_reply(self, header: dict) -> dict:
+        snap = self.fleet.snapshot()
+        jobs = header.get("jobs")
+        if isinstance(jobs, list):
+            tenant = self.fleet.tenant_for_token(header.get("token", ""))
+            snap["placement"] = {
+                str(j): self.fleet.place(tenant, str(j)).name for j in jobs
+            }
+        return {"ok": True, "fleet": snap}
+
+    def _alive_backends(self) -> List[BackendSpec]:
+        return [
+            b
+            for b in self.fleet.cfg.backends
+            if self.fleet.registry.is_alive(b.name)
+        ]
+
+    def _fanout(self, verb: str, header: dict) -> Tuple[dict, bytes]:
+        """One call per live backend with the CLIENT's token (the backend
+        does the tenant scoping), merged under a ``backends`` section."""
+        from gelly_streaming_tpu.runtime.client import ClientError, GellyClient
+
+        replies: Dict[str, dict] = {}
+        for spec in self._alive_backends():
+            head = dict(header)
+            try:
+                with GellyClient(
+                    spec.host,
+                    spec.port,
+                    token=str(header.get("token", "") or ""),
+                    timeout=self.cfg.fanout_timeout_s,
+                ) as client:
+                    reply_head, _pay = client.call_raw(head)
+                replies[spec.name] = reply_head
+            except (OSError, ClientError) as e:
+                self.fleet.registry.report_failure(spec.name)
+                replies[spec.name] = {"ok": False, "error": str(e)}
+        return self._merge(verb, replies), b""
+
+    @staticmethod
+    def _sum_numeric(into: dict, add: dict) -> dict:
+        """Recursive merge summing numeric leaves — the cross-backend
+        aggregation for totals-shaped dicts."""
+        for k, v in add.items():
+            if isinstance(v, dict):
+                into[k] = GLYRouter._sum_numeric(dict(into.get(k) or {}), v)
+            elif isinstance(v, bool) or not isinstance(v, (int, float)):
+                into.setdefault(k, v)
+            elif isinstance(into.get(k), (int, float)):
+                into[k] = into[k] + v
+            else:
+                into[k] = v
+        return into
+
+    def _merge(self, verb: str, replies: Dict[str, dict]) -> dict:
+        oks = {
+            n: r for n, r in sorted(replies.items()) if r.get("ok")
+        }
+        out: dict = {"ok": True, "backends": replies}
+        if verb == "status":
+            lines: List[str] = []
+            server = {"connections": 0, "served_jobs": 0}
+            status = {"jobs": {}, "totals": {}, "admitted_state_bytes": 0}
+            sketch_jobs: dict = {}
+            tenants: dict = {}
+            job_backend: dict = {}
+            for name, r in oks.items():
+                lines.extend(f"[{name}] {ln}" for ln in r.get("lines", []))
+                self._sum_numeric(server, r.get("server", {}))
+                st = r.get("status", {})
+                status["jobs"].update(st.get("jobs", {}))
+                job_backend.update(
+                    {job_id: name for job_id in st.get("jobs", {})}
+                )
+                self._sum_numeric(status["totals"], st.get("totals", {}))
+                status["admitted_state_bytes"] += int(
+                    st.get("admitted_state_bytes", 0) or 0
+                )
+                sketch_jobs.update(r.get("sketch_jobs", {}))
+                self._sum_numeric(tenants, r.get("tenants", {}))
+            server.pop("port", None)  # summing ports is meaningless
+            out.update(
+                lines=lines,
+                server=server,
+                status=status,
+                sketch_jobs=sketch_jobs,
+                tenants=tenants,
+                # which backend each merged job row came from — the
+                # gelly-top --fleet BACKEND column
+                job_backend=job_backend,
+            )
+        elif verb == "metrics":
+            merged: dict = {
+                "jobs": {},
+                "tenants": {},
+                "job_totals": {},
+                "tenant_totals": {},
+                "histograms": {"jobs": {}, "tenants": {}},
+                "scale": {},
+                "pipeline": {},
+                "spans": {},
+            }
+            for _name, r in oks.items():
+                m = r.get("metrics", {})
+                # job-keyed sections union cleanly (each job lives on ONE
+                # backend); tenant/process sections sum their counters
+                merged["jobs"].update(m.get("jobs", {}))
+                merged["scale"].update(m.get("scale", {}))
+                self._sum_numeric(merged["tenants"], m.get("tenants", {}))
+                self._sum_numeric(
+                    merged["job_totals"], m.get("job_totals", {})
+                )
+                self._sum_numeric(
+                    merged["tenant_totals"], m.get("tenant_totals", {})
+                )
+                self._sum_numeric(merged["pipeline"], m.get("pipeline", {}))
+                self._sum_numeric(merged["spans"], m.get("spans", {}))
+                hists = m.get("histograms", {})
+                merged["histograms"]["jobs"].update(hists.get("jobs", {}))
+                # quantiles don't sum: per-tenant histogram rows stay
+                # per-backend (full fidelity lives under "backends")
+                merged["histograms"]["tenants"].update(
+                    hists.get("tenants", {})
+                )
+            out["metrics"] = merged
+        elif verb == "health":
+            health = {"jobs": {}, "alerts": [], "monitor": None}
+            for name, r in oks.items():
+                h = r.get("health", {})
+                health["jobs"].update(h.get("jobs", {}))
+                health["alerts"].extend(
+                    dict(a, backend=name) for a in h.get("alerts", [])
+                )
+            out["health"] = health
+        elif verb == "alerts":
+            out["alerts"] = [
+                dict(a, backend=name)
+                for name, r in oks.items()
+                for a in r.get("alerts", [])
+            ]
+        elif verb == "events":
+            evs = [
+                dict(ev, backend=name)
+                for name, r in oks.items()
+                for ev in r.get("events", [])
+            ]
+            evs.sort(key=lambda ev: ev.get("ts", 0))
+            out["events"] = evs
+        elif verb == "trace":
+            spans: List[dict] = []
+            active = False
+            for name, r in oks.items():
+                spans.extend(
+                    dict(s, backend=name) for s in r.get("spans", [])
+                )
+                active = active or bool(r.get("tracing_active"))
+            out.update(spans=spans, tracing_active=active)
+        elif verb == "drain":
+            cursors: dict = {}
+            for _name, r in oks.items():
+                cursors.update(r.get("cursors", {}))
+            out["cursors"] = cursors
+        return out
+
+
+# ---------------------------------------------------------------------------
+# console script
+# ---------------------------------------------------------------------------
+
+
+def _load_fleet_config(conf: dict) -> Tuple[FleetConfig, dict]:
+    backends = []
+    for b in conf.get("backends", []):
+        host, _, port = str(b.get("addr", "")).rpartition(":")
+        if not host or not port.isdigit():
+            raise SystemExit(
+                f"backend {b.get('name')!r} needs addr host:port, got "
+                f"{b.get('addr')!r}"
+            )
+        backends.append(
+            BackendSpec(
+                name=str(b.get("name") or f"{host}:{port}"),
+                host=host,
+                port=int(port),
+                journal_path=b.get("journal"),
+                checkpoint_prefix=b.get("checkpoint_prefix"),
+                standby=bool(b.get("standby")),
+            )
+        )
+    tokens = {
+        str(t["tenant"]): str(t.get("token", ""))
+        for t in conf.get("tenants", [])
+    }
+    fleet_cfg = FleetConfig(
+        backends=tuple(backends),
+        replica_dir=conf.get("replica_dir"),
+        tenant_tokens=tokens,
+        probe_interval_s=float(conf.get("probe_interval_s", 0.3)),
+        probe_timeout_s=float(conf.get("probe_timeout_s", 2.0)),
+        fail_threshold=int(conf.get("fail_threshold", 2)),
+        replicate_interval_s=float(conf.get("replicate_interval_s", 0.5)),
+    )
+    return fleet_cfg, conf.get("rebalance") or {}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gelly-router",
+        description="GLY1 fleet router: place tenants/jobs across N "
+        "gelly-serve backends with journal-replicated warm-standby "
+        "failover (see runtime/router.py for the config shape)",
+    )
+    parser.add_argument(
+        "--config",
+        required=True,
+        help="JSON fleet config: {listen, replica_dir, tenants: "
+        "[{tenant, token}], backends: [{name, addr, journal, "
+        "checkpoint_prefix, standby}], rebalance: {...}}",
+    )
+    parser.add_argument(
+        "--listen",
+        metavar="HOST:PORT",
+        help="listen address (overrides the config's; PORT 0 binds an "
+        "ephemeral port, printed on stderr)",
+    )
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        conf = json.load(f)
+    fleet_cfg, rb_conf = _load_fleet_config(conf)
+    if not fleet_cfg.backends:
+        print("no backends in config", file=sys.stderr)
+        return 2
+    listen = args.listen or conf.get("listen") or "127.0.0.1:0"
+    host, _, port_s = listen.rpartition(":")
+    if not host or not port_s.isdigit():
+        print(f"--listen needs HOST:PORT, got {listen!r}", file=sys.stderr)
+        return 2
+    fleet = Fleet(fleet_cfg)
+    rebalancer = None
+    if rb_conf.get("enabled", bool(rb_conf)):
+        policy = RebalancePolicy(
+            interval_s=float(rb_conf.get("interval_s", 2.0)),
+            page_streak=int(rb_conf.get("page_streak", 3)),
+            cooldown_s=float(rb_conf.get("cooldown_s", 60.0)),
+        )
+        rebalancer = FleetRebalancer(fleet, policy=policy)
+    router = GLYRouter(
+        fleet, RouterConfig(host=host, port=int(port_s)), rebalancer
+    )
+    if conf.get("events_path"):
+        from gelly_streaming_tpu.utils import events
+
+        events.configure(path=conf["events_path"])
+    with router:
+        # machine-readable so drivers/tests can find an ephemeral port
+        print(
+            f"gelly-router: listening on {host}:{router.port}",
+            file=sys.stderr,
+            flush=True,
+        )
+        for spec in fleet.cfg.backends:
+            role = "standby" if spec.standby else "serving"
+            print(
+                f"gelly-router: backend {spec.name} {spec.host}:{spec.port}"
+                f" [{role}]",
+                file=sys.stderr,
+                flush=True,
+            )
+        while not router.wait_shutdown(5.0):
+            pass
+        print("gelly-router: shutdown requested", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,6 +132,12 @@ def main(argv=None) -> int:
         "checkpoint_prefix)",
     )
     parser.add_argument(
+        "--events-path",
+        help="JSONL event-journal path (overrides the config's "
+        "events_path) — fleet deployments point every backend at its own "
+        "journal so the standby can replay it (runtime/fleet.py)",
+    )
+    parser.add_argument(
         "--decode-workers",
         type=int,
         default=-1,
@@ -223,9 +229,9 @@ def main(argv=None) -> int:
     except (TypeError, ValueError) as e:
         print(f"bad slos config: {e}", file=sys.stderr)
         return 2
-    if conf.get("events_path"):
+    if args.events_path or conf.get("events_path"):
         events.configure(
-            path=conf["events_path"],
+            path=args.events_path or conf["events_path"],
             max_bytes=int(conf.get("events_max_bytes", 4 << 20)),
         )
     # elastic control plane (ISSUE 11): "autoscale": 1 starts the scaling

@@ -871,6 +871,14 @@ class StreamServer:
                 self._jobs[key] = sj
         if old is not None:
             old.abandon()  # a terminal predecessor's buffered records go
+        # the journal's submit-spec record: the EXACT client spec, so a
+        # fleet failover (runtime/fleet.py) can resubmit the job on a
+        # standby from journal replay alone — the replicated checkpoint
+        # then supplies the resume cursor.  The spec arrived as a JSON
+        # frame header, so it journals verbatim.
+        events.journal().emit(
+            "job_spec", job=key, tenant=tenant.tenant, spec=dict(spec)
+        )
         scaler = self.manager.autoscaler
         if scaler is not None and source is not None and checkpoint_path and w:
             # elastic control plane: put the job under management — the
@@ -1054,10 +1062,19 @@ class StreamServer:
                     "bad-spec", f"unknown push kind {kind!r} (wire/bdv/tail)"
                 )
         except PushOutOfSync as e:
-            # positionally stale (raced a rescale/drain): the client
-            # re-syncs from the cursor; the connection survives
+            # positionally stale (raced a rescale/drain, or reattached
+            # after a fleet failover): the client re-syncs from the
+            # ADVERTISED cursor — ``expected`` is the source's exact
+            # position, so a reconnecting pusher re-declares without a
+            # second round trip; the connection survives
             metrics.tenant_add(tenant.tenant, "tenant_ingest_rejects", 1)
-            return protocol.error_reply(str(e), code="out-of-sync"), b"", False
+            return (
+                protocol.error_reply(
+                    str(e), code="out-of-sync", expected=e.expected
+                ),
+                b"",
+                False,
+            )
         except ValueError as e:
             # a well-formed frame carrying a bad wire buffer: refuse the
             # BUFFER, keep the connection (the client can correct and go on)

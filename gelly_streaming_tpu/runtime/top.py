@@ -76,9 +76,12 @@ def render_frame(
     prev: Optional[dict],
     dt: Optional[float],
     health: Optional[dict] = None,
+    fleet: Optional[dict] = None,
 ) -> list:
     """One frame's console lines from a status reply + metrics snapshot
-    (+ the ``health`` verb's reply, when polled).
+    (+ the ``health`` verb's reply, when polled; + the router's ``fleet``
+    verb snapshot under ``--fleet``, which also switches the job table to
+    the BACKEND column using the merged status reply's ``job_backend``).
 
     ``prev``/``dt`` carry the previous poll's per-job edge counters for
     the eps column (None on the first frame).  Pure function of its
@@ -94,12 +97,35 @@ def render_frame(
         f"inflight_hwm={pipeline.get('pipeline_inflight_high_water', 0)} "
         f"spans={spans.get('recorded', 0)}"
     )
+    job_backend = status.get("job_backend") or {}
+    if fleet is not None:
+        backends = fleet.get("backends", {})
+        up = sum(1 for b in backends.values() if b.get("alive"))
+        standby = fleet.get("standby") or "-"
+        takeover = fleet.get("takeover", {})
+        pins = fleet.get("pins", {})
+        lines.append(
+            f"fleet: {up}/{len(backends)} backends up  standby={standby}  "
+            f"takeover={len(takeover)} tenant(s)  pins={len(pins)}"
+        )
+        for bname in sorted(backends):
+            b = backends[bname]
+            state = "up" if b.get("alive") else "DOWN"
+            rtt = b.get("rtt_ms")
+            rtt_s = f" rtt={rtt:.1f}ms" if isinstance(rtt, float) else ""
+            role = " standby" if b.get("standby") else ""
+            lines.append(
+                f"  {bname:<16} {b.get('host')}:{b.get('port')} "
+                f"[{state}]{role}{rtt_s}"
+            )
     jobs = status.get("status", {}).get("jobs", {})
     hist_jobs = metrics_snap.get("histograms", {}).get("jobs", {})
     scale_rows = metrics_snap.get("scale", {})
+    backend_col = fleet is not None
     lines.append(
         f"{'JOB':<24} {'STATE':<9} {'RECORDS':>8} {'EPS':>8} {'QUEUE':>5} "
         f"{'CLOSE p50/p99ms':>16} {'1ST-EMIT p50ms':>14} {'SCALE':<14}"
+        + (f" {'BACKEND':<12}" if backend_col else "")
     )
     for job_id in sorted(jobs):
         row = jobs[job_id]
@@ -118,6 +144,11 @@ def render_frame(
             f"{_quantiles(hrows, 'window_close_to_emission_ms'):>16} "
             f"{first_s:>14} "
             f"{_scale_cell(scale_rows, job_id):<14.14}"
+            + (
+                f" {job_backend.get(job_id, '?'):<12.12}"
+                if backend_col
+                else ""
+            )
         )
     if health:
         hjobs = health.get("jobs", {})
@@ -175,12 +206,14 @@ def frame_dict(
     prev: Optional[dict],
     dt: Optional[float],
     health: Optional[dict] = None,
+    fleet: Optional[dict] = None,
 ) -> dict:
     """The machine-readable frame (``--json``): the SAME view the console
     renders, as one JSON-ready object per poll — per-job status rows with
     the computed eps delta, tenant ledger, health gauges, and alert rows.
     Pure function of its inputs (tests pin the shape without a server)."""
     jobs = {}
+    job_backend = status.get("job_backend") or {}
     for job_id, row in status.get("status", {}).get("jobs", {}).items():
         out = dict(row)
         if prev is not None and dt and job_id in prev:
@@ -189,9 +222,12 @@ def frame_dict(
             )
         else:
             out["eps"] = None
+        if fleet is not None:
+            out["backend"] = job_backend.get(job_id)
         jobs[job_id] = out
     health = health or {}
     return {
+        **({"fleet": fleet} if fleet is not None else {}),
         "server": status.get("server", {}),
         "jobs": jobs,
         "tenants": metrics_snap.get("tenants", {}),
@@ -235,6 +271,13 @@ def main(argv=None) -> int:
         default=0,
         help="stop after N frames (0 = until interrupted)",
     )
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="--connect points at a gelly-router: render the fleet "
+        "topology (backends up/down, standby, takeovers) and a BACKEND "
+        "column on the merged job table (works with --json --once)",
+    )
     args = parser.parse_args(argv)
 
     from gelly_streaming_tpu.runtime.client import (
@@ -254,6 +297,11 @@ def main(argv=None) -> int:
             status = client.status()
             snap = client.metrics()
             health = client.health()
+            fleet = (
+                client.call({"verb": "fleet"})[0]["fleet"]
+                if args.fleet
+                else None
+            )
             now = time.monotonic()
             dt = (now - prev_t) if prev_t is not None else None
             if args.json:
@@ -261,13 +309,17 @@ def main(argv=None) -> int:
 
                 print(
                     _json.dumps(
-                        frame_dict(status, snap, prev_edges, dt, health),
+                        frame_dict(
+                            status, snap, prev_edges, dt, health, fleet
+                        ),
                         sort_keys=True,
                     ),
                     flush=True,
                 )
             else:
-                lines = render_frame(status, snap, prev_edges, dt, health)
+                lines = render_frame(
+                    status, snap, prev_edges, dt, health, fleet
+                )
                 if interactive:
                     sys.stdout.write("\x1b[2J\x1b[H")
                 print("\n".join(lines), flush=True)

@@ -1,0 +1,56 @@
+"""Seeded bugs: the fleet tier's lock discipline broken both ways (ISSUE
+20).  The router's placement pin table and the relay set are
+'# guarded-by:' their locks yet mutated bare, and the failover path
+(registry lock, then via ``_redirect`` the placement lock) inverts the
+order the placement path takes (placement lock, then via
+``_probe_alive`` the registry lock) — no single function acquires both,
+so only the interprocedural propagation can see the cycle.
+
+Expected findings: exactly two UNGUARDED (the module pin table and the
+instance relay set) and one LOCKORDER naming the
+_REGISTRY->_PLACEMENT->_REGISTRY cycle.  Analyzer input only — never
+imported.
+"""
+
+import threading
+
+_REGISTRY = threading.Lock()
+_PLACEMENT = threading.Lock()
+
+_ALIVE = {}  # guarded-by: _REGISTRY
+_PINS = {}  # guarded-by: _PLACEMENT
+
+
+def pin(key, backend):
+    _PINS[key] = backend  # BUG: races place() reading the table under lock
+
+
+def failover(name, standby):
+    with _REGISTRY:
+        _ALIVE[name] = False
+        _redirect(name, standby)
+
+
+def _redirect(name, standby):
+    with _PLACEMENT:
+        _PINS[name] = standby
+
+
+def place(key):
+    with _PLACEMENT:
+        backend = _PINS.get(key)
+        return backend if _probe_alive(backend) else None
+
+
+def _probe_alive(backend):
+    with _REGISTRY:
+        return _ALIVE.get(backend, False)
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._relays = set()  # guarded-by: _lock
+
+    def attach(self, relay):
+        self._relays.add(relay)  # BUG: races stop() snapshotting the set

@@ -1,0 +1,59 @@
+"""The well-formed twin of bad_router.py: every touch of the pin table
+and relay set holds its declared lock, and both the failover and the
+placement paths acquire in the one declared order
+(``# lock-order: _REGISTRY < _PLACEMENT``) — failover nests placement
+under registry, and placement resolves liveness BEFORE taking its own
+lock instead of nesting the registry lock inside it.
+Expected findings: none.  Analyzer input only — never imported.
+"""
+
+import threading
+
+# lock-order: _REGISTRY < _PLACEMENT
+
+_REGISTRY = threading.Lock()
+_PLACEMENT = threading.Lock()
+
+_ALIVE = {}  # guarded-by: _REGISTRY
+_PINS = {}  # guarded-by: _PLACEMENT
+
+
+def pin(key, backend):
+    with _PLACEMENT:
+        _PINS[key] = backend
+
+
+def failover(name, standby):
+    with _REGISTRY:
+        _ALIVE[name] = False
+        _redirect(name, standby)
+
+
+def _redirect(name, standby):
+    # nested under _REGISTRY in failover(): agrees with the declared order
+    with _PLACEMENT:
+        _PINS[name] = standby
+
+
+def place(key):
+    # liveness first (registry lock released), THEN the placement lock:
+    # the same _REGISTRY-before-_PLACEMENT order failover takes
+    alive = _probe_alive()
+    with _PLACEMENT:
+        backend = _PINS.get(key)
+        return backend if backend in alive else None
+
+
+def _probe_alive():
+    with _REGISTRY:
+        return {name for name, up in _ALIVE.items() if up}
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._relays = set()  # guarded-by: _lock
+
+    def attach(self, relay):
+        with self._lock:
+            self._relays.add(relay)

@@ -684,6 +684,23 @@ def test_corpus_lockorder():
     assert _analyze("good_lockorder.py") == []
 
 
+def test_corpus_router():
+    """The fleet-tier fixtures (ISSUE 20): the placement pin table and the
+    relay set are '# guarded-by:' state, and the failover path's
+    registry->placement nesting against the placement path's
+    placement->registry nesting is a two-function-pair inversion only the
+    interprocedural propagation can see."""
+    findings = _analyze("bad_router.py")
+    assert _codes(findings) == ["LOCKORDER", "UNGUARDED", "UNGUARDED"]
+    unguarded = [f for f in findings if f.code == "UNGUARDED"]
+    assert any("_PINS" in f.message for f in unguarded)
+    assert any("self._relays" in f.message for f in unguarded)
+    (order,) = [f for f in findings if f.code == "LOCKORDER"]
+    assert "_REGISTRY" in order.message and "_PLACEMENT" in order.message
+    assert "bad_router.py:" in order.message  # the acquisition chains
+    assert _analyze("good_router.py") == []
+
+
 def test_corpus_toctou():
     """The split-lock check-then-act (ISSUE 12, the PR 7 tenant-cap steal
     shape): both accesses correctly locked, but in two acquisitions."""

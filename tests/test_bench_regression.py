@@ -124,6 +124,17 @@ def test_direction_rules():
     assert bench._bench_direction("sketch_admitted") is None
     assert bench._bench_direction("sketch_exact_admitted") is None
     assert bench._bench_direction("sketch_triangle_exact") is None
+    # the fleet-tier headlines (ISSUE 20): aggregate eps at each backend
+    # count and the 4-vs-1 scaling ratio regress downward; the router's
+    # placed-verb tax, the failover downtime, and the behind-the-router
+    # retrace guard upward
+    assert bench._bench_direction("fleet_agg_eps_1") == "higher"
+    assert bench._bench_direction("fleet_agg_eps_2") == "higher"
+    assert bench._bench_direction("fleet_agg_eps_4") == "higher"
+    assert bench._bench_direction("fleet_scaling_ratio") == "higher"
+    assert bench._bench_direction("router_overhead_p50_ms") == "lower"
+    assert bench._bench_direction("fleet_failover_downtime_ms") == "lower"
+    assert bench._bench_direction("fleet_warm_recompiles") == "lower"
 
 
 def test_fresh_at_best_passes(baselines, capsys):

@@ -1,0 +1,502 @@
+"""Fleet tier control plane (ISSUE 20): registry, replication, failover,
+rebalancing — and the kill-a-backend chaos test.
+
+The contracts under test:
+
+* PLACEMENT — rendezvous hashing is deterministic, spreads keys, and is
+  overridden by pins and takeovers (never by re-hashing on liveness
+  flaps).
+* LIVENESS — ``report_failure`` transitions a backend down exactly once
+  at the threshold, from probe OR data-plane reports.
+* REPLICATION — journals and ``per_job_file`` checkpoints ship atomically
+  to the standby's paths; unchanged files are skipped.
+* FAILOVER — journal replay resubmits exactly the non-terminal
+  ``job_spec`` records on the standby and installs the takeover.
+* REBALANCE — the Autoscaler's streak/cooldown policy shape, evaluated
+  deterministically with an injected clock and burn probe.
+* CHAOS — SIGKILL a backend mid-stream under 2 tenants x 2 jobs behind a
+  live router: the standby reattaches the dead backend's jobs at their
+  resume cursors, resilient clients finish with exact non-idempotent
+  counts and overlap-only emissions, and ``job_history`` replayed across
+  the replica + standby journals spans both incarnations.
+
+Every test carries ``timeout_cap`` (threads/sockets/subprocess).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.runtime.client import GellyClient
+from gelly_streaming_tpu.runtime.fleet import (
+    BackendSpec,
+    Fleet,
+    FleetConfig,
+    FleetRebalancer,
+    RebalancePolicy,
+)
+from gelly_streaming_tpu.utils import events
+from gelly_streaming_tpu.utils.checkpoint import per_job_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.timeout_cap(600)
+
+CAP = 1 << 10
+W = 1 << 8
+B = 1 << 7
+N = 8 * W
+
+
+def _specs(n, standby=False, **kw):
+    specs = [
+        BackendSpec(f"b{i + 1}", "127.0.0.1", 7400 + i, **kw)
+        for i in range(n)
+    ]
+    if standby:
+        specs.append(BackendSpec("sb", "127.0.0.1", 7499, standby=True, **kw))
+    return tuple(specs)
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_placement_deterministic_spread_and_overrides():
+    fleet = Fleet(FleetConfig(backends=_specs(3, standby=True)))
+    keys = [("t1", f"job-{i}") for i in range(48)]
+    first = {k: fleet.place(*k).name for k in keys}
+    # deterministic: a second resolution (or a second router) agrees
+    assert {k: fleet.place(*k).name for k in keys} == first
+    # spread: every serving backend owns some keys, the standby none
+    assert set(first.values()) == {"b1", "b2", "b3"}
+    # pins override rendezvous for exactly their key
+    tenant, job = keys[0]
+    other = "b1" if first[keys[0]] != "b1" else "b2"
+    fleet.pin(tenant, job, other)
+    assert fleet.place(tenant, job).name == other
+    assert fleet.place(*keys[1]).name == first[keys[1]]
+    # a takeover redirects EVERY key of the dead backend to the standby
+    with fleet._lock:
+        fleet._takeover["b2"] = "sb"
+    for k, name in first.items():
+        want = "sb" if name == "b2" and k != keys[0] else first[k]
+        if k == keys[0]:
+            want = "sb" if other == "b2" else other
+        assert fleet.place(*k).name == want
+
+
+def test_tenant_for_token_inverts_configured_tokens():
+    fleet = Fleet(
+        FleetConfig(
+            backends=_specs(2),
+            tenant_tokens={"t1": "tok1", "t2": "tok2"},
+        )
+    )
+    assert fleet.tenant_for_token("tok1") == "t1"
+    assert fleet.tenant_for_token("tok2") == "t2"
+    assert fleet.tenant_for_token("") == "default"
+    # unknown tokens hash as themselves: placement stays consistent
+    assert fleet.tenant_for_token("mystery") == "mystery"
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_down_transition_fires_exactly_once():
+    from gelly_streaming_tpu.runtime.fleet import BackendRegistry
+
+    downs = []
+    reg = BackendRegistry(
+        _specs(2), fail_threshold=2, on_down=lambda s: downs.append(s.name)
+    )
+    reg.report_failure("b1")
+    assert reg.is_alive("b1") and not downs
+    reg.report_failure("b1")
+    assert not reg.is_alive("b1") and downs == ["b1"]
+    # further failures don't re-fire the transition
+    reg.report_failure("b1")
+    assert downs == ["b1"]
+    # recovery re-arms it
+    reg.mark_up("b1")
+    reg.report_failure("b1")
+    reg.report_failure("b1")
+    assert downs == ["b1", "b1"]
+    # unknown names are ignored, not crashed on
+    reg.report_failure("nope")
+
+
+def test_probe_once_reports_unreachable_backends():
+    import socket as socket_mod
+
+    from gelly_streaming_tpu.runtime.fleet import BackendRegistry
+
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    reg = BackendRegistry(
+        (BackendSpec("gone", "127.0.0.1", dead_port),),
+        probe_timeout_s=1.0,
+        fail_threshold=2,
+    )
+    assert reg.probe_once() == {"gone": True}  # first strike
+    assert reg.probe_once() == {"gone": False}  # threshold
+    snap = reg.snapshot()
+    assert snap["gone"]["alive"] is False
+    assert snap["gone"]["fails"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# replication
+# ---------------------------------------------------------------------------
+
+
+def test_sync_backend_ships_journal_and_checkpoints_atomically(tmp_path):
+    b1_ck = str(tmp_path / "b1" / "ck")
+    sb_ck = str(tmp_path / "sb" / "ck")
+    journal = tmp_path / "b1" / "journal.jsonl"
+    journal.parent.mkdir(parents=True)
+    journal.write_text('{"kind": "job_spec", "job": "t1/j"}\n')
+    np.savez(per_job_file(b1_ck, "t1.j"), cursor=np.int64(512))
+    b1 = BackendSpec(
+        "b1", "127.0.0.1", 7400,
+        journal_path=str(journal), checkpoint_prefix=b1_ck,
+    )
+    sb = BackendSpec(
+        "sb", "127.0.0.1", 7499, checkpoint_prefix=sb_ck, standby=True,
+    )
+    fleet = Fleet(
+        FleetConfig(backends=(b1, sb), replica_dir=str(tmp_path / "replica"))
+    )
+    stats = fleet.sync_backend(b1)
+    assert stats["files"] == 2 and stats["bytes"] > 0
+    assert os.path.exists(fleet.replica_journal_path("b1"))
+    shipped = per_job_file(sb_ck, "t1.j")
+    assert os.path.exists(shipped)
+    assert int(np.load(shipped)["cursor"]) == 512
+    # unchanged sources are skipped (size+mtime match)
+    assert fleet.sync_backend(b1) == {"files": 0, "bytes": 0}
+    # a changed journal ships again
+    with open(journal, "a") as f:
+        f.write('{"kind": "job_spec", "job": "t1/k"}\n')
+    assert fleet.sync_backend(b1)["files"] == 1
+    assert fleet.snapshot()["replication"]["syncs"] == 3
+
+
+# ---------------------------------------------------------------------------
+# failover from journal replay (standby = in-process server)
+# ---------------------------------------------------------------------------
+
+
+def test_failover_resubmits_only_live_jobs_and_installs_takeover(tmp_path):
+    from gelly_streaming_tpu.core.config import ServerConfig
+    from gelly_streaming_tpu.runtime import JobManager, StreamServer
+
+    replica_dir = tmp_path / "replica"
+    replica_dir.mkdir()
+    spec = {
+        "name": "live", "query": "edges", "capacity": CAP,
+        "window_edges": W, "batch": B,
+    }
+    done_spec = dict(spec, name="done")
+    rows = [
+        {"kind": "job_spec", "job": "default/live", "tenant": "default",
+         "spec": spec},
+        {"kind": "job_submitted", "job": "default/live"},
+        {"kind": "job_spec", "job": "default/done", "tenant": "default",
+         "spec": done_spec},
+        {"kind": "job_submitted", "job": "default/done"},
+        {"kind": "job_transition", "job": "default/done",
+         "from": "PENDING", "to": "RUNNING"},
+        {"kind": "job_transition", "job": "default/done",
+         "from": "RUNNING", "to": "DONE"},
+    ]
+    (replica_dir / "journal-b1.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    with JobManager() as jm, StreamServer(jm, ServerConfig()) as standby:
+        fleet = Fleet(
+            FleetConfig(
+                backends=(
+                    BackendSpec("b1", "127.0.0.1", 1),  # dead by construction
+                    BackendSpec(
+                        "sb", "127.0.0.1", standby.port, standby=True
+                    ),
+                ),
+                replica_dir=str(replica_dir),
+            )
+        )
+        outcome = fleet.failover("b1")
+        assert [r["job"] for r in outcome["resubmitted"]] == ["default/live"]
+        assert outcome["failed"] == []
+        assert fleet.takeover_map() == {"b1": "sb"}
+        assert fleet.place("default", "live").name == "sb"
+        # the standby actually serves the job now
+        with GellyClient("127.0.0.1", standby.port) as c:
+            assert "default/live" in c.status()["status"]["jobs"]
+        # failover runs at most once per backend
+        assert fleet.failover("b1")["resubmitted"] == []
+
+
+# ---------------------------------------------------------------------------
+# rebalancer policy (deterministic: injected clock + burn probe)
+# ---------------------------------------------------------------------------
+
+
+def test_rebalancer_streak_cooldown_and_target_choice(monkeypatch):
+    fleet = Fleet(FleetConfig(backends=_specs(3)))
+    moves = []
+    monkeypatch.setattr(
+        fleet, "rebalance",
+        lambda tenant, src, dst: (
+            moves.append((tenant, src, dst))
+            or {"tenant": tenant, "moved": [], "failed": []}
+        ),
+    )
+    burning = {"b1": {"t1": True}}
+    rb = FleetRebalancer(
+        fleet,
+        policy=RebalancePolicy(page_streak=3, cooldown_s=60.0),
+        burn_probe=lambda spec: burning.get(spec.name, {}),
+    )
+    assert rb.evaluate_once(0.0) == []  # streak 1
+    assert rb.evaluate_once(1.0) == []  # streak 2
+    rb.evaluate_once(2.0)  # streak 3: actuates
+    # target = coldest (fewest pins), name-tiebroken: b2
+    assert moves == [("t1", "b1", "b2")]
+    # cooldown holds the pair even under sustained burn
+    for t in (3.0, 4.0, 5.0):
+        rb.evaluate_once(t)
+    assert len(moves) == 1
+    # a burn-free evaluation resets the streak
+    burning.clear()
+    rb.evaluate_once(6.0)
+    burning["b1"] = {"t1": True}
+    rb.evaluate_once(70.0)  # cooled + streak 1 (was reset): no move
+    assert len(moves) == 1
+    rb.evaluate_once(71.0)
+    rb.evaluate_once(72.0)  # streak 3 again, past cooldown: moves
+    assert len(moves) == 2
+
+
+def test_rebalancer_pick_target_skips_dead_and_taken_over():
+    fleet = Fleet(FleetConfig(backends=_specs(3, standby=True)))
+    rb = FleetRebalancer(fleet, burn_probe=lambda spec: {})
+    assert rb._pick_target("b1") == "b2"
+    fleet.registry.report_failure("b2")
+    fleet.registry.report_failure("b2")  # threshold: down
+    assert rb._pick_target("b1") == "b3"
+    with fleet._lock:
+        fleet._takeover["b3"] = "sb"
+    assert rb._pick_target("b1") is None
+
+
+# ---------------------------------------------------------------------------
+# CHAOS: SIGKILL a backend mid-stream, standby takeover, exact counts
+# ---------------------------------------------------------------------------
+
+
+def _spawn_backend(tmp_path, name, conf):
+    bdir = tmp_path / name
+    bdir.mkdir(exist_ok=True)
+    conf_path = bdir / "conf.json"
+    conf_path.write_text(json.dumps(conf))
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gelly_streaming_tpu.runtime.serve",
+            "--listen", "127.0.0.1:0",
+            "--config", str(conf_path),
+            "--checkpoint-prefix", str(bdir / "ck"),
+            "--events-path", str(bdir / "journal.jsonl"),
+            "--status-interval", "0",
+        ],
+        env=env,
+        stderr=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+    )
+    return proc, bdir
+
+
+def _await_port(proc):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline().decode()
+        if "listening on" in line:
+            return int(line.rsplit(":", 1)[1])
+        if not line and proc.poll() is not None:
+            break
+    raise AssertionError("backend child never reported its port")
+
+
+def test_chaos_sigkill_backend_standby_takeover_exact_counts(tmp_path):
+    """The tentpole's acceptance pin.  2 tenants x 2 checkpointed jobs
+    spread over 2 backends + 1 warm standby behind a live router; half
+    the stream in, SIGKILL the backend hosting jobs; the resilient
+    clients finish through the SAME router address.  Every job must show
+    exact non-idempotent counts (``second[-1] == N``), overlap-only
+    emissions (no gaps), and a ``job_history`` chain that spans both
+    incarnations when the replica + standby journals are replayed."""
+    from gelly_streaming_tpu.runtime.router import GLYRouter, RouterConfig
+
+    conf = {
+        "jobs": [],
+        "tenants": [
+            {"tenant": "t1", "token": "tok1"},
+            {"tenant": "t2", "token": "tok2"},
+        ],
+    }
+    procs = {}
+    for name in ("b1", "b2", "sb"):
+        procs[name] = _spawn_backend(tmp_path, name, conf)
+    try:
+        ports = {name: _await_port(proc) for name, (proc, _d) in procs.items()}
+        specs = tuple(
+            BackendSpec(
+                name,
+                "127.0.0.1",
+                ports[name],
+                journal_path=str(tmp_path / name / "journal.jsonl"),
+                checkpoint_prefix=str(tmp_path / name / "ck"),
+                standby=(name == "sb"),
+            )
+            for name in ("b1", "b2", "sb")
+        )
+        fleet = Fleet(
+            FleetConfig(
+                backends=specs,
+                replica_dir=str(tmp_path / "replica"),
+                tenant_tokens={"t1": "tok1", "t2": "tok2"},
+                probe_interval_s=0.1,
+                probe_timeout_s=1.0,
+                fail_threshold=2,
+                replicate_interval_s=0.2,
+            )
+        )
+        jobs = [
+            ("t1", "tok1", "jx", 51), ("t1", "tok1", "jy", 52),
+            ("t2", "tok2", "jx", 53), ("t2", "tok2", "jy", 54),
+        ]
+        serial = [(i + 1) * W for i in range(N // W)]
+        half = N // 2
+        datasets = {}
+        first = {}
+        with GLYRouter(fleet, RouterConfig()) as router:
+            clients = {}
+            try:
+                for tenant, token, job, seed in jobs:
+                    rng = np.random.default_rng(seed)
+                    src = rng.integers(0, CAP, N).astype(np.int32)
+                    dst = rng.integers(0, CAP, N).astype(np.int32)
+                    datasets[(tenant, job)] = (src, dst)
+                    c = GellyClient("127.0.0.1", router.port, token=token)
+                    clients[(tenant, job)] = c
+                    c.submit(
+                        name=job, query="edges", capacity=CAP,
+                        window_edges=W, batch=B, checkpoint=True,
+                    )
+                    c.push_edges(
+                        job, src[:half], dst[:half], batch=B, capacity=CAP,
+                        close=False,
+                    )
+                # fetch EVERY closed window's record so a checkpointed-
+                # but-unfetched window can't read as a gap (the final
+                # pushed window only closes when the NEXT edge crosses
+                # its boundary, so half/W edges close half/W - 1 windows)
+                closed = half // W - 1
+                for (tenant, job), c in clients.items():
+                    got = []
+                    deadline = time.monotonic() + 120
+                    while len(got) < closed and time.monotonic() < deadline:
+                        recs, _state, _eos = c.results(job, timeout_ms=2000)
+                        got.extend(int(r[0]) for r in recs)
+                    first[(tenant, job)] = got
+                    assert got == serial[:closed], (tenant, job, got)
+                # durable state shipped BEFORE the kill (deterministic:
+                # drive the replication tick directly)
+                fleet.replicate_once()
+
+                placement = {
+                    (tenant, job): fleet.place(tenant, job).name
+                    for tenant, _tok, job, _s in jobs
+                }
+                victim = max(
+                    ("b1", "b2"),
+                    key=lambda n: sum(
+                        1 for v in placement.values() if v == n
+                    ),
+                )
+                victim_jobs = [
+                    k for k, v in placement.items() if v == victim
+                ]
+                assert victim_jobs, placement
+                vproc, _vdir = procs[victim]
+                vproc.kill()  # SIGKILL: no drain, no cleanup, no atexit
+                vproc.wait(timeout=30)
+
+                # finish every stream through the SAME router address;
+                # resilient pushes ride rerouted -> reconnect ->
+                # out-of-sync resync onto the standby
+                second = {}
+                for tenant, token, job, _seed in jobs:
+                    c = clients[(tenant, job)]
+                    src, dst = datasets[(tenant, job)]
+                    c.push_edges_resilient(
+                        job, src, dst, batch=B, capacity=CAP, start=half,
+                        deadline_s=120.0, backoff_s=0.1,
+                    )
+                    second[(tenant, job)] = [
+                        int(r[0])
+                        for r in c.iter_results(job, deadline_s=240)
+                    ]
+            finally:
+                for c in clients.values():
+                    c.close()
+
+            # takeover installed: the dead backend's keys now resolve to
+            # the standby (and the survivor's keys did NOT move)
+            assert fleet.takeover_map() == {victim: "sb"}
+            for key, backend in placement.items():
+                want = "sb" if backend == victim else backend
+                assert fleet.place(*key).name == want
+
+        for key in placement:
+            a, b = first[key], second[key]
+            # exact non-idempotent count: state folded exactly once
+            assert b[-1] == N, (key, b)
+            overlap = len(a) + len(b) - len(serial)
+            assert overlap >= 0, (key, "kill dropped emissions (a gap)", a, b)
+            assert a[: len(a) - overlap] + b == serial, (key, a, b)
+            if key in victim_jobs:
+                # the standby REPLAYED from its replicated cursor: the
+                # at-least-once overlap is exactly the server-directed
+                # re-push past the resume point
+                assert overlap >= 0
+
+        # the lifecycle chain spans both incarnations: replica journal
+        # (dead backend's sync) + the standby's own journal
+        evs = events.replay(fleet.replica_journal_path(victim))
+        evs += events.replay(str(tmp_path / "sb" / "journal.jsonl"))
+        for tenant, job in victim_jobs:
+            history = events.job_history(evs, f"{tenant}/{job}")
+            assert len(history) >= 2, (tenant, job, history)
+            assert history[-1][0] == "PENDING"
+    finally:
+        for proc, _d in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
